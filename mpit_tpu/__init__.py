@@ -1,0 +1,38 @@
+"""mpit_tpu — a TPU-native asynchronous parameter-server training framework.
+
+A brand-new JAX/XLA framework with the capabilities of the reference system
+mpiT ("MPI for Torch", see /root/reference): a sharded asynchronous parameter
+server with the msgd / DOWNPOUR / EASGD / EAMSGD family of distributed
+optimizers (plus server-side RMSProp/Adam/Adamax/Adagrad/Adadelta shard
+rules), driving real workloads (MNIST classification, BiCNN answer
+selection).
+
+It is *not* a port.  Where the reference stacks Lua coroutines over a
+generated Lua<->MPI C binding (reference: mpiT.c, lua-mpi.h, mpifuncs.c,
+init.lua, queue.lua), this framework is built TPU-first:
+
+- compute lives in jitted XLA programs (Flax models, pure-functional
+  optimizers, jitted shard-update rules) running on HBM-resident arrays;
+- multi-chip scaling is expressed with ``jax.sharding.Mesh`` + ``pjit`` /
+  ``shard_map`` and XLA collectives (psum / all_gather / ppermute) over ICI;
+- the truly-asynchronous host paths (the analog of the reference's
+  MPI_Isend/Irecv coroutine machinery) are a native C++ transport
+  (shared-memory rings for same-host processes, TCP for cross-host) driven
+  through ctypes bindings generated from JSON specs — mirroring the
+  reference's readspec.py codegen, but emitting Python, not C.
+
+Layer map (cf. SURVEY.md section 1):
+
+====  ==============================  ==========================================
+L5    launchers / experiment drivers  mpit_tpu.train.launch
+L4    workloads (models+train loops)  mpit_tpu.train, mpit_tpu.models, mpit_tpu.data
+L3    distributed optimizers          mpit_tpu.optim
+L2    parameter-server protocol       mpit_tpu.ps
+L1    async engine (scheduler/queue)  mpit_tpu.aio
+L0    transports (native C++ / ICI)   mpit_tpu.comm
+====  ==============================  ==========================================
+"""
+
+__version__ = "0.1.0"
+
+from mpit_tpu.utils.config import Config  # noqa: F401

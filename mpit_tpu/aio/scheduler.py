@@ -1,0 +1,247 @@
+"""Cooperative task scheduler (analog of reference init.lua:21-25,128-185).
+
+The reference schedules Lua coroutines that yield one of five signals; the
+scheduler pops one coroutine from a FIFO, resumes it one step, and re-pushes
+it unless it finished (init.lua:147-174).  ``co_wait`` spins until the queue
+drains (init.lua:178-185).  That cooperative single-step model is what lets
+a parameter-server client overlap communication polls with device compute
+(``pc:ping()``, reference optim-eamsgd.lua:63) without threads.
+
+Here tasks are Python generators.  A generator yields ``EXEC`` (still
+working — typically between transfer polls) and returns normally when done;
+its return value is captured.  Exceptions become ``ERR`` state and are
+re-raised from :meth:`Scheduler.wait` unless the task was spawned with
+``swallow_errors``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Generator, Optional
+
+from mpit_tpu.aio.queue import Queue
+
+# Task signals (reference init.lua:21-25).  INIT/OK are retained for state
+# reporting; the scheduler itself only reacts to EXEC (keep going) vs DONE.
+INIT = "INIT"
+EXEC = "EXEC"
+OK = "OK"
+ERR = "ERR"
+DONE = "DONE"
+
+
+class TaskError(RuntimeError):
+    """An exception raised inside a scheduled task, with the task attached."""
+
+    def __init__(self, task: "Task", cause: BaseException):
+        super().__init__(f"task {task.name!r} failed: {cause!r}")
+        self.task = task
+        self.cause = cause
+
+
+class Task:
+    """A cooperatively-scheduled unit of work wrapping a generator.
+
+    The generator is *not* primed at construction; the scheduler steps it.
+    ``result`` holds the generator's return value once state is DONE.
+    """
+
+    __slots__ = ("gen", "name", "state", "result", "error", "on_done")
+
+    def __init__(
+        self,
+        gen: Generator[Any, None, Any],
+        name: str = "task",
+        on_done: Optional[Callable[["Task"], None]] = None,
+    ) -> None:
+        self.gen = gen
+        self.name = name
+        self.state = INIT
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.on_done = on_done
+
+    def step(self) -> str:
+        """Advance the generator one yield.  Returns the new state."""
+        if self.state in (DONE, ERR):
+            return self.state
+        try:
+            self.gen.send(None) if self.state != INIT else next(self.gen)
+            self.state = EXEC
+        except StopIteration as stop:
+            self.result = stop.value
+            self.state = DONE
+            if self.on_done is not None:
+                self.on_done(self)
+        except BaseException as exc:  # noqa: BLE001 — recorded, re-raised by wait()
+            self.error = exc
+            self.state = ERR
+        return self.state
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Task({self.name!r}, state={self.state})"
+
+
+class Scheduler:
+    """FIFO round-robin scheduler of generator tasks.
+
+    One scheduler per role-process (server or client), exactly as the
+    reference runs one coroutine queue per rank.  Methods map to the
+    reference API: ``spawn`` = co_execute (init.lua:133-144), ``ping`` =
+    co_ping (init.lua:147-174), ``wait`` = co_wait (init.lua:178-185).
+    """
+
+    def __init__(self) -> None:
+        self.queue: Queue[Task] = Queue()
+        self.errors: list[TaskError] = []
+
+    # -- co_execute ---------------------------------------------------------
+    def spawn(
+        self,
+        gen: Generator[Any, None, Any],
+        name: str = "task",
+        on_done: Optional[Callable[[Task], None]] = None,
+    ) -> Task:
+        """Create a task, prime it with one step, queue it if still running."""
+        task = Task(gen, name=name, on_done=on_done)
+        self._step_and_requeue(task)
+        return task
+
+    # -- co_ping ------------------------------------------------------------
+    def ping(self) -> Optional[Task]:
+        """Pop one task, advance it one step, re-queue unless finished.
+
+        Returns the task stepped (or None when the queue is empty).  This is
+        the comm/compute-overlap primitive: call between device ops to make
+        transfer progress without blocking.
+        """
+        task = self.queue.pop()
+        if task is None:
+            return None
+        self._step_and_requeue(task)
+        return task
+
+    # -- co_wait ------------------------------------------------------------
+    def wait(self, usec: float = 0.0, deadline: Optional[float] = None) -> None:
+        """Drain the queue, optionally sleeping ``usec`` microseconds between
+        rounds (the reference defaults to 0 for I/O throughput, README:65).
+
+        Raises the first :class:`TaskError` encountered after draining; with
+        ``deadline`` (seconds), raises TimeoutError if tasks remain.
+        """
+        t_end = None if deadline is None else time.monotonic() + deadline
+        while self.queue:
+            self.ping()
+            if usec > 0:
+                time.sleep(usec * 1e-6)
+            if t_end is not None and time.monotonic() > t_end and self.queue:
+                raise TimeoutError(
+                    f"scheduler.wait: {len(self.queue)} task(s) still pending "
+                    f"after {deadline}s: {[t.name for t in self.queue]}"
+                )
+        if self.errors:
+            raise self.errors.pop(0)
+
+    def wait_for(self, task: Task, usec: float = 0.0) -> Any:
+        """Drive the queue until ``task`` completes; return its result."""
+        while task.state not in (DONE, ERR):
+            if not self.queue:
+                raise RuntimeError(f"task {task.name!r} pending but queue empty")
+            self.ping()
+            if usec > 0:
+                time.sleep(usec * 1e-6)
+        if task.state == ERR:
+            raise TaskError(task, task.error)  # type: ignore[arg-type]
+        return task.result
+
+    def _step_and_requeue(self, task: Task) -> None:
+        state = task.step()
+        if state == EXEC:
+            self.queue.push(task)
+        elif state == ERR:
+            self.errors.append(TaskError(task, task.error))  # type: ignore[arg-type]
+
+    def __len__(self) -> int:
+        return len(self.queue)
+
+
+# ---------------------------------------------------------------------------
+# Async transfer generators (analog of reference init.lua:40-102).
+#
+# A transport (mpit_tpu.comm) exposes nonblocking primitives:
+#   isend(data, dst, tag) -> handle          irecv(src, tag) -> handle
+#   test(handle) -> bool                     iprobe(src, tag) -> bool
+#   cancel(handle) -> None                   payload(handle) -> bytes/array
+# The generators below poll those handles, yielding EXEC between polls, and
+# honour a shared LiveFlag for the graceful-shutdown cancel path
+# (reference init.lua:50-58,88-96; README:71).
+# ---------------------------------------------------------------------------
+
+
+class LiveFlag:
+    """Shared on/off switch for a role-process's I/O (reference ``state.io``)."""
+
+    __slots__ = ("io", "on")
+
+    def __init__(self) -> None:
+        self.io = True  # transfers may progress
+        self.on = True  # service loops may continue
+
+    def stop(self) -> None:
+        self.io = False
+        self.on = False
+
+
+def aio_send(
+    transport: Any,
+    data: Any,
+    dst: int,
+    tag: int,
+    live: Optional[LiveFlag] = None,
+    cb: Optional[Callable[[Any], None]] = None,
+) -> Generator[str, None, None]:
+    """Nonblocking send: post, then poll-test until complete.
+
+    Mirrors reference init.lua:40-65 — including the shutdown path: when the
+    live flag drops, the in-flight send is cancelled so buffer ownership
+    returns to the caller before exit.
+    """
+    handle = transport.isend(data, dst, tag)
+    while not transport.test(handle):
+        if live is not None and not live.io:
+            transport.cancel(handle)
+            return
+        yield EXEC
+    if cb is not None:
+        cb(handle)
+
+
+def aio_recv(
+    transport: Any,
+    src: int,
+    tag: int,
+    live: Optional[LiveFlag] = None,
+    cb: Optional[Callable[[Any], None]] = None,
+    out: Optional[Any] = None,
+) -> Generator[str, None, Any]:
+    """Nonblocking receive: probe until a matching message exists, then post
+    the receive and poll it to completion.  Returns the payload.
+
+    Mirrors reference init.lua:67-102 (Iprobe poll -> Irecv -> Test poll,
+    cancel-on-shutdown).  ``out``, when given, is a preallocated buffer the
+    transport fills (the zero-copy analog of receiving into a tensor shard).
+    """
+    while not transport.iprobe(src, tag):
+        if live is not None and not live.io:
+            return None
+        yield EXEC
+    handle = transport.irecv(src, tag, out=out)
+    while not transport.test(handle):
+        if live is not None and not live.io:
+            transport.cancel(handle)
+            return None
+        yield EXEC
+    payload = transport.payload(handle)
+    if cb is not None:
+        cb(payload)
+    return payload

@@ -1,0 +1,6 @@
+"""Shared utilities: typed config, phase timers, logging, serialization."""
+
+from mpit_tpu.utils.config import Config
+from mpit_tpu.utils.timers import PhaseTimers
+
+__all__ = ["Config", "PhaseTimers"]

@@ -1,0 +1,95 @@
+"""Object and array (de)serialization for the wire.
+
+Analog of the reference's ``mpiT.serialize``/``deserialize`` via Torch
+MemoryFile (reference init.lua:104-126).  Two tiers:
+
+- **Arrays** travel as raw little-endian bytes with a tiny header (dtype,
+  shape) — the hot path; payloads are written straight from device buffers
+  (``np.asarray(jax_array)`` is zero-copy for host-resident committed data).
+- **Pytrees / control objects** travel as header-tagged pickled payloads —
+  only on cold control paths (init, config exchange), never per-step.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Tuple
+
+import numpy as np
+
+_ARRAY_MAGIC = b"MTA1"  # mpit-tpu array v1
+_OBJECT_MAGIC = b"MTO1"  # mpit-tpu object v1
+
+
+def _dtype_name(dtype: np.dtype) -> str:
+    # np.dtype.str loses identity for extension types (bfloat16/fp8 from
+    # ml_dtypes map to '<V2'/'|V1'); the name round-trips via _resolve_dtype.
+    return dtype.name
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_array(array: Any) -> bytes:
+    """Array -> bytes.  Accepts numpy or JAX arrays (devices -> host copy)."""
+    host = np.ascontiguousarray(np.asarray(array))
+    dtype = _dtype_name(host.dtype).encode()  # e.g. b'float32', b'bfloat16'
+    header = struct.pack("<4sB", _ARRAY_MAGIC, len(dtype)) + dtype
+    header += struct.pack("<B", host.ndim)
+    header += struct.pack(f"<{host.ndim}q", *host.shape)
+    return header + host.tobytes()
+
+
+def decode_array(blob: bytes | memoryview, out: np.ndarray | None = None) -> np.ndarray:
+    """Bytes -> numpy array; fills ``out`` in place when given (zero-alloc path)."""
+    view = memoryview(blob)
+    magic, dlen = struct.unpack_from("<4sB", view, 0)
+    if magic != _ARRAY_MAGIC:
+        raise ValueError(f"bad array magic {magic!r}")
+    offset = 5
+    dtype = _resolve_dtype(bytes(view[offset : offset + dlen]).decode())
+    offset += dlen
+    (ndim,) = struct.unpack_from("<B", view, offset)
+    offset += 1
+    shape: Tuple[int, ...] = struct.unpack_from(f"<{ndim}q", view, offset)
+    offset += 8 * ndim
+    flat = np.frombuffer(view, dtype=dtype, offset=offset)
+    array = flat.reshape(shape)
+    if out is not None:
+        np.copyto(out, array)
+        return out
+    return array.copy()  # decouple from the transport buffer
+
+
+def encode_object(obj: Any) -> bytes:
+    return _OBJECT_MAGIC + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_object(blob: bytes | memoryview) -> Any:
+    view = memoryview(blob)
+    if bytes(view[:4]) != _OBJECT_MAGIC:
+        raise ValueError("bad object magic")
+    return pickle.loads(view[4:])
+
+
+def encode(obj: Any) -> bytes:
+    """Dispatch: arrays by value, everything else pickled."""
+    if isinstance(obj, np.ndarray) or type(obj).__module__.startswith("jax"):
+        return encode_array(obj)
+    return encode_object(obj)
+
+
+def decode(blob: bytes | memoryview) -> Any:
+    head = bytes(memoryview(blob)[:4])
+    if head == _ARRAY_MAGIC:
+        return decode_array(blob)
+    if head == _OBJECT_MAGIC:
+        return decode_object(blob)
+    raise ValueError(f"unknown payload magic {head!r}")

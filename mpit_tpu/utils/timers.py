@@ -1,0 +1,62 @@
+"""Per-phase wall-clock timers.
+
+The reference tracks phase times in ad-hoc tables — ``tm.feval``/``tm.sync``
+in the MNIST trainer (reference asyncsgd/goot.lua:20-22,152-157), an
+11-bucket table in BiCNN (reference BiCNN/bicnn.lua:17-28), and optimizers
+accumulate blocking sync time around every wait (reference
+optim-downpour.lua:39-41).  This is the same cheap mechanism with a context
+manager, plus hooks into jax.profiler for real traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+
+class PhaseTimers:
+    """Accumulate wall-clock seconds per named phase."""
+
+    def __init__(self) -> None:
+        self.total: Dict[str, float] = defaultdict(float)
+        self.count: Dict[str, int] = defaultdict(int)
+        self._t0 = time.monotonic()
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.monotonic()
+        try:
+            yield
+        finally:
+            self.total[name] += time.monotonic() - start
+            self.count[name] += 1
+
+    def add(self, name: str, seconds: float) -> None:
+        self.total[name] += seconds
+        self.count[name] += 1
+
+    def elapsed(self) -> float:
+        """Seconds since this timer set was created."""
+        return time.monotonic() - self._t0
+
+    def summary(self) -> str:
+        lines = [f"total elapsed {self.elapsed():.3f}s"]
+        for name in sorted(self.total):
+            tot, cnt = self.total[name], self.count[name]
+            avg = tot / max(cnt, 1)
+            lines.append(f"  {name:<16} {tot:9.3f}s  n={cnt:<8d} avg={avg * 1e3:8.3f}ms")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace_annotation(name: str) -> Iterator[None]:
+    """jax.profiler annotation when available, no-op otherwise."""
+    try:
+        import jax.profiler as _prof
+
+        with _prof.TraceAnnotation(name):
+            yield
+    except Exception:  # pragma: no cover - profiler unavailable
+        yield

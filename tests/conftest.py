@@ -1,0 +1,26 @@
+"""Test harness: force an 8-virtual-device CPU JAX platform.
+
+Multi-chip code paths (mesh sharding, collectives, role-split parallelism)
+are exercised without TPU hardware by asking XLA for 8 host devices — the
+analog of the reference running N MPI ranks on one host over the
+shared-memory transport as its "fake backend" (reference README.md:28-31,
+SURVEY.md section 4).  Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override any preset TPU/axon platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

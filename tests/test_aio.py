@@ -1,0 +1,231 @@
+"""Tests for the L1 async engine: Queue, Scheduler, aio_send/aio_recv.
+
+Coverage model follows the reference's semantics (queue.lua FIFO behavior,
+init.lua scheduler round-robin, cancel-on-shutdown) but as real assertions
+rather than eyeballed prints (SURVEY.md section 4).
+"""
+
+import pytest
+
+from mpit_tpu.aio import (
+    DONE,
+    EXEC,
+    LiveFlag,
+    Queue,
+    Scheduler,
+    TaskError,
+    aio_recv,
+    aio_send,
+)
+
+
+class TestQueue:
+    def test_fifo_order(self):
+        q = Queue()
+        for i in range(5):
+            q.push(i)
+        assert [q.pop() for _ in range(5)] == [0, 1, 2, 3, 4]
+
+    def test_pop_empty_returns_none(self):
+        assert Queue().pop() is None
+
+    def test_len_and_bool(self):
+        q = Queue()
+        assert not q and len(q) == 0
+        q.push("x")
+        assert q and len(q) == 1
+
+    def test_interleaved(self):
+        q = Queue()
+        q.push(1)
+        q.push(2)
+        assert q.pop() == 1
+        q.push(3)
+        assert q.pop() == 2
+        assert q.pop() == 3
+
+
+class TestScheduler:
+    def test_spawn_runs_to_completion(self):
+        sched = Scheduler()
+        log = []
+
+        def work():
+            for i in range(3):
+                log.append(i)
+                yield EXEC
+
+        task = sched.spawn(work(), name="w")
+        sched.wait()
+        assert task.state == DONE
+        assert log == [0, 1, 2]
+
+    def test_round_robin_interleaves(self):
+        sched = Scheduler()
+        log = []
+
+        def work(tag, n):
+            for i in range(n):
+                log.append((tag, i))
+                yield EXEC
+
+        sched.spawn(work("a", 2))
+        sched.spawn(work("b", 2))
+        sched.wait()
+        # Spawn primes one step each, then round-robin alternates.
+        assert log == [("a", 0), ("b", 0), ("a", 1), ("b", 1)]
+
+    def test_return_value_captured(self):
+        sched = Scheduler()
+
+        def work():
+            yield EXEC
+            return 42
+
+        task = sched.spawn(work())
+        assert sched.wait_for(task) == 42
+
+    def test_immediate_completion(self):
+        sched = Scheduler()
+
+        def work():
+            return "done"
+            yield  # pragma: no cover
+
+        task = sched.spawn(work())
+        assert task.state == DONE
+        assert task.result == "done"
+        assert len(sched) == 0
+
+    def test_error_propagates_from_wait(self):
+        sched = Scheduler()
+
+        def boom():
+            yield EXEC
+            raise ValueError("boom")
+
+        sched.spawn(boom(), name="boom")
+        with pytest.raises(TaskError) as excinfo:
+            sched.wait()
+        assert isinstance(excinfo.value.cause, ValueError)
+
+    def test_ping_single_steps(self):
+        sched = Scheduler()
+        log = []
+
+        def work():
+            log.append("a")
+            yield EXEC
+            log.append("b")
+
+        sched.spawn(work())  # primes: runs to first yield
+        assert log == ["a"]
+        sched.ping()
+        assert log == ["a", "b"]
+        assert len(sched) == 0
+
+    def test_wait_deadline(self):
+        sched = Scheduler()
+
+        def forever():
+            while True:
+                yield EXEC
+
+        sched.spawn(forever())
+        with pytest.raises(TimeoutError):
+            sched.wait(deadline=0.05)
+
+    def test_on_done_callback(self):
+        sched = Scheduler()
+        seen = []
+
+        def work():
+            yield EXEC
+            return 7
+
+        sched.spawn(work(), on_done=lambda t: seen.append(t.result))
+        sched.wait()
+        assert seen == [7]
+
+
+class FakeTransport:
+    """Scripted transport: messages become visible/complete after N polls."""
+
+    def __init__(self, send_delay=2, recv_delay=2):
+        self.send_delay = send_delay
+        self.recv_delay = recv_delay
+        self.mailbox = {}
+        self.cancelled = []
+        self._handles = {}
+        self._next = 0
+
+    def isend(self, data, dst, tag):
+        handle = self._next
+        self._next += 1
+        self._handles[handle] = {"polls": 0, "data": data, "dst": dst, "tag": tag}
+        return handle
+
+    def irecv(self, src, tag, out=None):
+        handle = self._next
+        self._next += 1
+        self._handles[handle] = {"polls": 0, "data": self.mailbox[(src, tag)]}
+        return handle
+
+    def iprobe(self, src, tag):
+        entry = self.mailbox.get((src, tag))
+        if entry is None:
+            return False
+        probe = self._handles.setdefault(("probe", src, tag), {"polls": 0})
+        probe["polls"] += 1
+        return probe["polls"] > self.recv_delay
+
+    def test(self, handle):
+        info = self._handles[handle]
+        info["polls"] += 1
+        if info["polls"] > self.send_delay:
+            if "dst" in info:
+                self.mailbox[(info["dst"], info["tag"])] = info["data"]
+            return True
+        return False
+
+    def cancel(self, handle):
+        self.cancelled.append(handle)
+
+    def payload(self, handle):
+        return self._handles[handle]["data"]
+
+
+class TestAioTransfers:
+    def test_send_then_recv(self):
+        transport = FakeTransport()
+        sched = Scheduler()
+        got = []
+        sched.spawn(aio_send(transport, b"hello", dst=1, tag=3), name="send")
+        recv = sched.spawn(
+            aio_recv(transport, src=1, tag=3, cb=got.append), name="recv"
+        )
+        sched.wait()
+        assert got == [b"hello"]
+        assert recv.result == b"hello"
+
+    def test_send_cancelled_on_stop(self):
+        transport = FakeTransport(send_delay=10**9)
+        sched = Scheduler()
+        live = LiveFlag()
+        sched.spawn(aio_send(transport, b"x", dst=0, tag=1, live=live))
+        for _ in range(3):
+            sched.ping()
+        live.stop()
+        sched.wait()
+        assert transport.cancelled  # in-flight send released (reference README:71)
+
+    def test_recv_cancelled_while_probing(self):
+        transport = FakeTransport()  # nothing ever arrives
+        sched = Scheduler()
+        live = LiveFlag()
+        task = sched.spawn(aio_recv(transport, src=0, tag=1, live=live))
+        sched.ping()
+        live.stop()
+        sched.wait()
+        assert task.state == DONE
+        assert task.result is None

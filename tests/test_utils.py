@@ -1,0 +1,86 @@
+"""Tests for config, timers, serialization utilities."""
+
+import numpy as np
+import pytest
+
+from mpit_tpu.utils.config import Config
+from mpit_tpu.utils.serialize import (
+    decode,
+    decode_array,
+    encode_array,
+    encode_object,
+)
+from mpit_tpu.utils.timers import PhaseTimers
+
+
+class TestConfig:
+    def test_attribute_and_item_access(self):
+        cfg = Config(lr=0.01, opt="easgd")
+        assert cfg.lr == 0.01
+        assert cfg["opt"] == "easgd"
+
+    def test_get_default(self):
+        cfg = Config(lr=0.01)
+        assert cfg.get("missing", 7) == 7
+
+    def test_merged_precedence(self):
+        base = Config(lr=0.01, mom=0.99)
+        out = base.merged({"lr": 0.1}, mom=0.5)
+        assert out.lr == 0.1 and out.mom == 0.5
+        assert base.lr == 0.01  # original untouched
+
+    def test_parse_args_typed(self):
+        cfg = Config(lr=0.01, epochs=10, cuda=False, name="sgd")
+        out = cfg.parse_args(["--lr", "0.5", "--cuda", "true", "--epochs", "3"])
+        assert out.lr == 0.5 and out.cuda is True and out.epochs == 3
+        assert out.name == "sgd"
+
+    def test_missing_attribute_raises(self):
+        with pytest.raises(AttributeError):
+            Config().nope
+
+
+class TestTimers:
+    def test_phase_accumulates(self):
+        tm = PhaseTimers()
+        with tm.phase("feval"):
+            pass
+        with tm.phase("feval"):
+            pass
+        assert tm.count["feval"] == 2
+        assert tm.total["feval"] >= 0.0
+        assert "feval" in tm.summary()
+
+
+class TestSerialize:
+    def test_array_roundtrip(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = decode_array(encode_array(arr))
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == np.float32
+
+    def test_array_into_preallocated(self):
+        arr = np.linspace(0, 1, 8, dtype=np.float32)
+        out = np.empty_like(arr)
+        result = decode_array(encode_array(arr), out=out)
+        assert result is out
+        np.testing.assert_array_equal(out, arr)
+
+    def test_bfloat16_via_jax(self):
+        import jax.numpy as jnp
+
+        arr = jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3)
+        out = decode(encode_array(arr))
+        np.testing.assert_array_equal(np.asarray(arr, dtype=np.float32),
+                                      np.asarray(out, dtype=np.float32))
+
+    def test_object_roundtrip(self):
+        obj = {"offset": 3, "size": (5, 2), "name": "shard"}
+        assert decode(encode_object(obj)) == obj
+
+    def test_dispatch(self):
+        arr = np.ones(4, dtype=np.int32)
+        from mpit_tpu.utils.serialize import encode
+
+        np.testing.assert_array_equal(decode(encode(arr)), arr)
+        assert decode(encode({"a": 1})) == {"a": 1}
