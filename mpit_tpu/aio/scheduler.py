@@ -10,8 +10,7 @@ a parameter-server client overlap communication polls with device compute
 Here tasks are Python generators.  A generator yields ``EXEC`` (still
 working — typically between transfer polls) and returns normally when done;
 its return value is captured.  Exceptions become ``ERR`` state and are
-re-raised from :meth:`Scheduler.wait` unless the task was spawned with
-``swallow_errors``.
+re-raised from :meth:`Scheduler.wait` / :meth:`Scheduler.wait_for`.
 """
 
 from __future__ import annotations
@@ -66,7 +65,7 @@ class Task:
         if self.state in (DONE, ERR):
             return self.state
         try:
-            self.gen.send(None) if self.state != INIT else next(self.gen)
+            next(self.gen)
             self.state = EXEC
         except StopIteration as stop:
             self.result = stop.value
@@ -123,8 +122,10 @@ class Scheduler:
 
     # -- co_wait ------------------------------------------------------------
     def wait(self, usec: float = 0.0, deadline: Optional[float] = None) -> None:
-        """Drain the queue, optionally sleeping ``usec`` microseconds between
-        rounds (the reference defaults to 0 for I/O throughput, README:65).
+        """Drain the queue, optionally sleeping ``usec`` microseconds after
+        each single-task ping — exactly the reference's co_wait cadence,
+        which defaults usec to 0 for I/O throughput (init.lua:178-185,
+        README:65).
 
         Raises the first :class:`TaskError` encountered after draining; with
         ``deadline`` (seconds), raises TimeoutError if tasks remain.
@@ -151,6 +152,9 @@ class Scheduler:
             if usec > 0:
                 time.sleep(usec * 1e-6)
         if task.state == ERR:
+            # Drop the queued duplicate so a later wait() doesn't re-raise
+            # an error the caller already handled here.
+            self.errors = [e for e in self.errors if e.task is not task]
             raise TaskError(task, task.error)  # type: ignore[arg-type]
         return task.result
 

@@ -1,0 +1,43 @@
+"""The parameter-client protocol the comm-aware optimizers drive.
+
+Mirrors the reference pClient surface (reference asyncsgd/pclient.lua:84-179):
+``start/reset`` register host-visible flat buffers, the ``async_*`` calls
+enqueue per-server transfer tasks, ``ping`` single-steps I/O to overlap with
+compute, ``wait`` drains, ``stop`` runs the shutdown protocol.
+
+The real implementation is :class:`mpit_tpu.ps.client.ParamClient`; optimizer
+unit tests substitute an in-process simulator.  Buffers are 1-D numpy arrays
+the client slices per server shard (numpy views = the zero-copy analog of
+``torch.Storage(grad, offset, size)``, reference pclient.lua:50-52).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class ParamClientAPI(Protocol):
+    def start(self, param: np.ndarray, grad: np.ndarray) -> None:
+        """Register buffers, announce shard offsets to servers, and (first
+        client only) seed the servers' shards from ``param``."""
+
+    def reset(self, param: np.ndarray, grad: np.ndarray) -> None:
+        """Retarget the transfer buffers (reference pclient.lua:138-151) —
+        e.g. EASGD points them at its center/elastic-delta copies."""
+
+    def async_send_grad(self) -> None: ...
+
+    def async_recv_param(self) -> None: ...
+
+    def async_send_param(self) -> None: ...
+
+    def ping(self) -> None:
+        """Make one unit of I/O progress without blocking."""
+
+    def wait(self) -> None:
+        """Block until all enqueued transfers complete."""
+
+    def stop(self) -> None: ...

@@ -1,0 +1,106 @@
+"""DOWNPOUR distributed SGD (reference asyncsgd/optim-downpour.lua).
+
+Semantics preserved exactly:
+
+- Every step computes ``dfdx = -(clr) * (grad + l2wd*w)`` with
+  ``clr = lr/(1 + k*lrd)`` (reference :22-28,48 — linear decay, no power).
+- ``su == 1`` (Hogwild-style): ship ``dfdx`` to the servers (which
+  plain-add it) and fetch fresh params every step (reference :46-54).
+- ``su > 1``: accumulate ``dfdx``; on every su-th step (k % su == 0,
+  checked *before* increment, so the first step syncs) ship the accumulated
+  delta and fetch params; between syncs apply ``dfdx`` locally
+  (reference :26-45).
+
+TPU-native changes from the reference mechanics (not semantics): the
+parameter vector, gradient, and the DOWNPOUR accumulator live in device HBM
+and the whole local step (feval + scale + accumulate + local move) is one
+jitted XLA program; host<->device transfers happen only on sync steps, and
+the host-side buffers the client ships are written with one device->host
+copy (the reference instead mutates shared host tensors every step).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpit_tpu.optim.client_api import ParamClientAPI
+
+
+class Downpour:
+    """Host driver around a jitted local step and a parameter client."""
+
+    def __init__(
+        self,
+        value_and_grad_fn: Callable[..., Tuple[jnp.ndarray, jnp.ndarray]],
+        pclient: ParamClientAPI,
+        *,
+        lr: float,
+        lrd: float = 0.0,
+        l2wd: float = 0.0,
+        su: int = 1,
+    ):
+        if su < 1:
+            raise ValueError("su must be >= 1 (reference asserts pc and su>=1)")
+        self.pc = pclient
+        self.su = su
+        self.k = 0
+        self.dusync = 0.0  # blocking-sync seconds (reference state.dusync)
+        self._started = False
+
+        def _local(w, accum, k, *args):
+            loss, g = value_and_grad_fn(w, *args)
+            if l2wd != 0:
+                g = g + l2wd * w
+            clr = lr / (1.0 + k.astype(jnp.float32) * lrd) if lrd != 0 else lr
+            dfdx = -clr * g
+            return loss, dfdx, accum + dfdx, w + dfdx
+
+        self._local = jax.jit(_local)
+
+    def start(self, w: jnp.ndarray) -> jnp.ndarray:
+        """Register buffers with the client; first client seeds servers."""
+        self.w_host = np.array(w, dtype=np.float32)
+        self.grad_host = np.zeros_like(self.w_host)
+        self.accum = jnp.zeros_like(w)
+        self.pc.start(self.w_host, self.grad_host)
+        self._started = True
+        return w
+
+    def step(self, w: jnp.ndarray, *fn_args: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        assert self._started, "call start(w) first"
+        k = jnp.asarray(self.k, jnp.int32)
+        loss, dfdx, accum, w_local = self._local(w, self.accum, k, *fn_args)
+
+        if self.su == 1:
+            np.copyto(self.grad_host, np.asarray(dfdx))
+            self.pc.async_send_grad()
+            self.pc.async_recv_param()
+            t0 = time.monotonic()
+            self.pc.wait()
+            self.dusync += time.monotonic() - t0
+            w = jnp.asarray(self.w_host)
+        elif self.k % self.su == 0:
+            # Ship the accumulated delta, fetch fresh params, clear accum.
+            np.copyto(self.grad_host, np.asarray(accum))
+            self.pc.async_send_grad()
+            self.pc.async_recv_param()
+            t0 = time.monotonic()
+            self.pc.wait()
+            self.dusync += time.monotonic() - t0
+            self.accum = jnp.zeros_like(accum)
+            w = jnp.asarray(self.w_host)
+        else:
+            self.accum = accum
+            w = w_local  # move locally between syncs (reference :44)
+
+        self.k += 1
+        return w, loss
+
+    def stop(self) -> None:
+        if self._started:
+            self.pc.stop()

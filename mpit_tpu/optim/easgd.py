@@ -1,0 +1,116 @@
+"""EASGD / EAMSGD — elastic-averaging distributed SGD
+(reference asyncsgd/optim-eamsgd.lua; mom == 0 gives EASGD, reference :3).
+
+Per sync round (every su-th step, first step included):
+
+1. fetch the center variable w* from the servers (reference :54-57);
+2. elastic delta ``sug = mva * (w - w*)`` computed against the *pre-update*
+   local w (reference :58-60);
+3. push sug as a "gradient" — servers plain-add, i.e. ``w* += mva*(w-w*)``
+   (reference :61); the push is *not* waited on: a single ``ping`` overlaps
+   it with the local compute (reference :62-64) and it completes during the
+   next round's ``wait`` at the latest;
+4. the local Nesterov update runs (same math as msgd minus the momentum
+   ramp, reference :24-45);
+5. ``w -= sug`` pulls the worker toward the center (reference :66).
+
+Between rounds only the local update runs.  TPU-native mechanics: w, vt and
+the elastic algebra live in device HBM; the elastic delta and local update
+are jitted XLA programs; only w* (in) and sug (out) cross the host boundary,
+once per round.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpit_tpu.optim.client_api import ParamClientAPI
+from mpit_tpu.optim.msgd import MSGDConfig, msgd_commit, msgd_init, msgd_lookahead
+
+
+class EAMSGD:
+    def __init__(
+        self,
+        value_and_grad_fn: Callable[..., Tuple[jnp.ndarray, jnp.ndarray]],
+        pclient: ParamClientAPI,
+        *,
+        lr: float,
+        lrd: float = 0.0,
+        lrp: float = 0.0,
+        mom: float = 0.0,
+        l2wd: float = 0.0,
+        mva: float = 0.0,  # moving rate alpha (mlaunch uses beta/p = 0.9/6)
+        su: int = 1,  # communication period tau
+    ):
+        if not (su > 0 and mva > 0):
+            raise ValueError("eamsgd requires su>0 and mva>0 (reference :86)")
+        self.pc = pclient
+        self.su = su
+        self.mva = mva
+        self.dusync = 0.0
+        self._started = False
+        # Local rule = msgd without the momentum ramp (reference :24-45).
+        cfg = MSGDConfig(lr=lr, lrd=lrd, lrp=lrp, mom=mom, momdecay=0.0, l2wd=l2wd)
+        self.cfg = cfg
+        self._skip_local = lr == 0.0  # reference :25 guards localupdate on lr~=0
+
+        def _localupdate(w, state, *args):
+            w_la, state = msgd_lookahead(w, state, cfg)
+            loss, grad = value_and_grad_fn(w_la, *args)
+            w_new, state = msgd_commit(w_la, grad, state, cfg)
+            return w_new, state, loss
+
+        self._localupdate = jax.jit(_localupdate)
+        self._elastic = jax.jit(lambda w, center: self.mva * (w - center))
+        self._retract = jax.jit(lambda w, sug: w - sug)
+
+    @property
+    def k(self) -> int:
+        return int(self.state["k"]) if self._started else 0
+
+    def start(self, w: jnp.ndarray) -> jnp.ndarray:
+        self.state = msgd_init(w)
+        self._steps = 0  # mirrors state["k"] host-side for the su modulus
+        # Dedicated comm copies: recv target for w*, send source for sug
+        # (reference :49-53 allocates suw/sug and retargets the client).
+        self.center_host = np.zeros(np.shape(w), dtype=np.float32)
+        self.sug_host = np.zeros_like(self.center_host)
+        self.pc.start(np.array(w, dtype=np.float32), self.sug_host)
+        self.pc.reset(self.center_host, self.sug_host)
+        self._started = True
+        return w
+
+    def step(self, w: jnp.ndarray, *fn_args: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        assert self._started, "call start(w) first"
+        sync_round = self._steps % self.su == 0
+        if sync_round:
+            self.pc.async_recv_param()  # center_host <- w*
+            t0 = time.monotonic()
+            self.pc.wait()  # completes this recv and any prior send
+            self.dusync += time.monotonic() - t0
+            sug = self._elastic(w, jnp.asarray(self.center_host))
+            np.copyto(self.sug_host, np.asarray(sug))
+            self.pc.async_send_grad()  # server: w* += sug
+            t0 = time.monotonic()
+            self.pc.ping()  # overlap I/O with local compute (reference :63)
+            self.dusync += time.monotonic() - t0
+
+        if self._skip_local:
+            loss = jnp.zeros(())
+        else:
+            w, self.state, loss = self._localupdate(w, self.state, *fn_args)
+            self._steps += 1
+
+        if sync_round:
+            w = self._retract(w, sug)  # w -= mva*(w - w*) (reference :66)
+        return w, loss
+
+    def stop(self) -> None:
+        if self._started:
+            self.pc.wait()  # drain the in-flight elastic push
+            self.pc.stop()

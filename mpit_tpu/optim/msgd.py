@@ -1,0 +1,120 @@
+"""Nesterov momentum SGD ("msgd") — the reference's local update rule.
+
+Semantics follow reference asyncsgd/optim-msgd.lua exactly:
+
+1. optional momentum ramp: ``mom_k = min(mommax, 1 - 0.5/(1 + k/momdecay))``
+   (reference :21-23);
+2. Sutskever-formulation lookahead: ``vt *= mom_k; w += vt`` *before* the
+   gradient is evaluated (reference :24-29) — so the gradient is taken at
+   the displaced point;
+3. L2 term added to the gradient at the displaced point (reference :31);
+4. lr decay ``clr = lr/(1 + k*lrd)^lrp`` (reference :33-35);
+5. ``w -= clr*g; vt -= clr*g`` (reference :36-39), step counter ``k += 1``.
+
+TPU-native shape: the whole step — lookahead, loss/grad, commit — is one
+pure function suitable for ``jax.jit`` and ``lax.scan`` over minibatches.
+The lookahead/commit halves are also exported separately because the
+EASGD/EAMSGD wrapper interleaves parameter-server traffic between them
+(reference optim-eamsgd.lua:24-45 embeds the same local update).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MSGDConfig(NamedTuple):
+    lr: float = 0.0
+    lrd: float = 0.0  # lr decay
+    lrp: float = 0.0  # lr decay power
+    mom: float = 0.0
+    mommax: float = 1.0
+    momdecay: float = 0.0
+    l2wd: float = 0.0
+    # Reference msgd enables decay only when lrd>0 AND lrp>0
+    # (optim-msgd.lua:33); eamsgd's embedded copy uses lrd!=0 AND lrp>0
+    # (optim-eamsgd.lua:40) — identical for the sane lrd>=0 regime.
+
+
+def msgd_init(w: Any) -> dict:
+    return {
+        "k": jnp.zeros((), jnp.int32),
+        "vt": jax.tree_util.tree_map(jnp.zeros_like, w),
+    }
+
+
+def _effective_momentum(cfg: MSGDConfig, k: jnp.ndarray) -> jnp.ndarray:
+    mom = jnp.asarray(cfg.mom, jnp.float32)
+    if cfg.mom > 0 and cfg.momdecay > 0:
+        mom = jnp.minimum(
+            cfg.mommax, 1.0 - 0.5 / (1.0 + k.astype(jnp.float32) / cfg.momdecay)
+        )
+    return mom
+
+
+def _effective_lr(cfg: MSGDConfig, k: jnp.ndarray) -> jnp.ndarray:
+    clr = jnp.asarray(cfg.lr, jnp.float32)
+    if cfg.lrd > 0 and cfg.lrp > 0:
+        clr = cfg.lr / jnp.power(1.0 + k.astype(jnp.float32) * cfg.lrd, cfg.lrp)
+    return clr
+
+
+def msgd_lookahead(w: Any, state: dict, cfg: MSGDConfig) -> Tuple[Any, dict]:
+    """Phase 1: scale velocity and displace w (reference :24-29)."""
+    if cfg.mom <= 0:
+        return w, state
+    mom = _effective_momentum(cfg, state["k"])
+    vt = jax.tree_util.tree_map(lambda v: mom * v, state["vt"])
+    w = jax.tree_util.tree_map(jnp.add, w, vt)
+    return w, {"k": state["k"], "vt": vt}
+
+
+def msgd_commit(w: Any, grad: Any, state: dict, cfg: MSGDConfig) -> Tuple[Any, dict]:
+    """Phase 2: weight-decay, decayed-lr descent, velocity update (:31-40)."""
+    if cfg.l2wd != 0:
+        grad = jax.tree_util.tree_map(lambda g, p: g + cfg.l2wd * p, grad, w)
+    clr = _effective_lr(cfg, state["k"])
+    w = jax.tree_util.tree_map(lambda p, g: p - clr * g, w, grad)
+    vt = state["vt"]
+    if cfg.mom > 0:
+        vt = jax.tree_util.tree_map(lambda v, g: v - clr * g, vt, grad)
+    return w, {"k": state["k"] + 1, "vt": vt}
+
+
+def msgd_step(
+    value_and_grad_fn: Callable[..., Tuple[jnp.ndarray, Any]],
+    w: Any,
+    state: dict,
+    cfg: MSGDConfig,
+    *fn_args: Any,
+) -> Tuple[Any, dict, jnp.ndarray]:
+    """One full msgd step: lookahead -> grad at displaced w -> commit.
+
+    ``value_and_grad_fn(w, *fn_args) -> (loss, grad)`` is the feval closure
+    analog (reference goot.lua:101-126).  Pure; jit the caller.
+    """
+    w_la, state = msgd_lookahead(w, state, cfg)
+    loss, grad = value_and_grad_fn(w_la, *fn_args)
+    w_new, state = msgd_commit(w_la, grad, state, cfg)
+    return w_new, state, loss
+
+
+class MSGD:
+    """Object wrapper with the same lifecycle as the comm-aware optimizers,
+    for uniform dispatch in trainers (reference goot.lua:66-89 dispatch)."""
+
+    def __init__(self, cfg: MSGDConfig, value_and_grad_fn: Callable[..., Tuple[jnp.ndarray, Any]]):
+        self.cfg = cfg
+        self._step = jax.jit(
+            lambda w, state, *args: msgd_step(value_and_grad_fn, w, state, cfg, *args)
+        )
+        self.state: dict | None = None
+
+    def step(self, w: Any, *fn_args: Any) -> Tuple[Any, jnp.ndarray]:
+        if self.state is None:
+            self.state = msgd_init(w)
+        w, self.state, loss = self._step(w, self.state, *fn_args)
+        return w, loss

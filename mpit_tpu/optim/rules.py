@@ -1,0 +1,238 @@
+"""Pure-functional shard-update rules (server-side optimizer math).
+
+In the reference, the parameter server applies an optimizer rule in place to
+its HBM^W RAM-resident shard every time a gradient arrives, with per-rule
+state tensors allocated next to the shard (reference BiCNN/pserver.lua:50-83
+for state allocation, :123-197 for the updates).  Here each rule is a pair
+of pure functions
+
+    init(p)              -> state            (a dict-of-arrays pytree)
+    apply(p, g, state)   -> (p_new, state_new)
+
+so the server can jit ``apply`` once per shard and reuse it for every
+incoming gradient, and single-worker mode can run the *same math* locally
+(the reference duplicates it in BiCNN/optim-*-single.lua; here it is one
+implementation).
+
+Update math is kept bit-faithful to the reference (including its quirks —
+e.g. Adam's ``floor(t/step_div)+1`` bias-correction exponent, Adamax's
+``|g|+eps`` inside the max, centered RMSProp with momentum).  All rules are
+shape-polymorphic and dtype-preserving; under jit the step counter lives in
+the state pytree as a traced scalar.
+
+The sign convention matches the reference wire protocol: clients ship either
+pre-scaled updates (``-lr*grad`` for DOWNPOUR, elastic deltas for EASGD) to
+be *plain-added*, or raw gradients for the server-side rules to consume.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+State = Dict[str, Any]
+Rule = Tuple[Callable[..., State], Callable[..., Tuple[jnp.ndarray, State]]]
+
+
+class ShardRule(NamedTuple):
+    """A (init, apply) pair with hyperparameters already bound."""
+
+    init: Callable[[jnp.ndarray], State]
+    apply: Callable[[jnp.ndarray, jnp.ndarray, State], Tuple[jnp.ndarray, State]]
+
+
+# ---------------------------------------------------------------------------
+# plain add — the default rule (reference asyncsgd/pserver.lua:83,
+# BiCNN/pserver.lua:197): clients pre-scale, server just accumulates.
+# ---------------------------------------------------------------------------
+
+
+def add_init(p: jnp.ndarray) -> State:
+    del p
+    return {}
+
+
+def add_apply(p: jnp.ndarray, g: jnp.ndarray, state: State) -> Tuple[jnp.ndarray, State]:
+    return p + g, state
+
+
+# ---------------------------------------------------------------------------
+# centered RMSProp with momentum (reference BiCNN/pserver.lua:123-139)
+# ---------------------------------------------------------------------------
+
+
+def rmsprop_init(p: jnp.ndarray) -> State:
+    zeros = jnp.zeros_like(p)
+    return {"grad_accum": zeros, "grad_sq_accum": zeros, "update": zeros}
+
+
+def rmsprop_apply(
+    p: jnp.ndarray,
+    g: jnp.ndarray,
+    state: State,
+    *,
+    lr: float = 1e-2,
+    decay: float = 0.95,
+    momentum: float = 0.9,
+    epsilon: float = 1e-4,
+) -> Tuple[jnp.ndarray, State]:
+    grad_accum = decay * state["grad_accum"] + (1.0 - decay) * g
+    grad_sq_accum = decay * state["grad_sq_accum"] + (1.0 - decay) * g * g
+    # Centered second moment: Var ≈ E[g²] - E[g]² (reference :133-136).
+    grad_rms = jnp.sqrt(grad_sq_accum - grad_accum * grad_accum + epsilon)
+    update = momentum * state["update"] - lr * g / grad_rms
+    return p + update, {
+        "grad_accum": grad_accum,
+        "grad_sq_accum": grad_sq_accum,
+        "update": update,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Adam (reference BiCNN/pserver.lua:140-155; single-worker variant
+# BiCNN/optim-adam-single.lua:23-32)
+# ---------------------------------------------------------------------------
+
+
+def adam_init(p: jnp.ndarray) -> State:
+    zeros = jnp.zeros_like(p)
+    return {"t": jnp.zeros((), jnp.int32), "m": zeros, "v": zeros}
+
+
+def adam_apply(
+    p: jnp.ndarray,
+    g: jnp.ndarray,
+    state: State,
+    *,
+    lr: float = 1e-3,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    epsilon: float = 1e-8,
+    step_div: int | None = None,
+) -> Tuple[jnp.ndarray, State]:
+    """``step_div`` set -> server-mode bias correction with exponent
+    ``floor(t/step_div)+1`` (reference :151-153 — dampens the correction when
+    many async clients drive ``t``); None -> plain exponent ``t``
+    (single-worker mode, reference optim-adam-single.lua:28-30)."""
+    t = state["t"] + 1
+    m = beta1 * state["m"] + (1.0 - beta1) * g
+    v = beta2 * state["v"] + (1.0 - beta2) * g * g
+    d = jnp.sqrt(v) + epsilon
+    if step_div is None:
+        exponent = t.astype(p.dtype)
+    else:
+        exponent = (t // step_div + 1).astype(p.dtype)
+    beta1_t = 1.0 - jnp.power(jnp.asarray(beta1, p.dtype), exponent)
+    beta2_t = 1.0 - jnp.power(jnp.asarray(beta2, p.dtype), exponent)
+    lr_t = lr * jnp.sqrt(beta2_t) / beta1_t
+    return p - lr_t * m / d, {"t": t, "m": m, "v": v}
+
+
+# ---------------------------------------------------------------------------
+# Adamax (reference BiCNN/pserver.lua:156-171)
+# ---------------------------------------------------------------------------
+
+
+def adamax_init(p: jnp.ndarray) -> State:
+    zeros = jnp.zeros_like(p)
+    return {"t": jnp.zeros((), jnp.int32), "m": zeros, "u": zeros}
+
+
+def adamax_apply(
+    p: jnp.ndarray,
+    g: jnp.ndarray,
+    state: State,
+    *,
+    lr: float = 2e-3,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    epsilon: float = 1e-8,
+) -> Tuple[jnp.ndarray, State]:
+    t = state["t"] + 1
+    m = beta1 * state["m"] + (1.0 - beta1) * g
+    # Note: epsilon inside the max, on |g| (reference :164-166).
+    u = jnp.maximum(beta2 * state["u"], jnp.abs(g) + epsilon)
+    beta1_t = 1.0 - jnp.power(jnp.asarray(beta1, p.dtype), t.astype(p.dtype))
+    lr_t = lr / beta1_t
+    return p - lr_t * m / u, {"t": t, "m": m, "u": u}
+
+
+# ---------------------------------------------------------------------------
+# Adagrad (reference BiCNN/pserver.lua:172-183)
+# ---------------------------------------------------------------------------
+
+
+def adagrad_init(p: jnp.ndarray) -> State:
+    return {"t": jnp.zeros((), jnp.int32), "variance": jnp.zeros_like(p)}
+
+
+def adagrad_apply(
+    p: jnp.ndarray,
+    g: jnp.ndarray,
+    state: State,
+    *,
+    lr: float = 1e-2,
+    lrd: float = 0.0,
+    epsilon: float = 1e-10,
+) -> Tuple[jnp.ndarray, State]:
+    clr = lr / (1.0 + state["t"].astype(p.dtype) * lrd)
+    variance = state["variance"] + g * g
+    std = jnp.sqrt(variance) + epsilon  # epsilon added post-sqrt (reference :180-181)
+    return p - clr * g / std, {"t": state["t"] + 1, "variance": variance}
+
+
+# ---------------------------------------------------------------------------
+# Adadelta (reference BiCNN/pserver.lua:184-195)
+# ---------------------------------------------------------------------------
+
+
+def adadelta_init(p: jnp.ndarray) -> State:
+    zeros = jnp.zeros_like(p)
+    return {"variance": zeros, "acc_delta": zeros}
+
+
+def adadelta_apply(
+    p: jnp.ndarray,
+    g: jnp.ndarray,
+    state: State,
+    *,
+    lr: float = 1.0,
+    rho: float = 0.9,
+    epsilon: float = 1e-6,
+) -> Tuple[jnp.ndarray, State]:
+    variance = rho * state["variance"] + (1.0 - rho) * g * g
+    std = jnp.sqrt(variance + epsilon)
+    delta = jnp.sqrt(state["acc_delta"] + epsilon) / std * g
+    acc_delta = rho * state["acc_delta"] + (1.0 - rho) * delta * delta
+    return p - lr * delta, {"variance": variance, "acc_delta": acc_delta}
+
+
+# ---------------------------------------------------------------------------
+# Registry — the analog of the reference's optimization-name dispatch
+# (BiCNN/pserver.lua:123,140,156,172,184 if/elseif chain).
+# ---------------------------------------------------------------------------
+
+_RULES: Dict[str, Tuple[Callable[..., State], Callable[..., Tuple[jnp.ndarray, State]]]] = {
+    "add": (add_init, add_apply),
+    "rmsprop": (rmsprop_init, rmsprop_apply),
+    "adam": (adam_init, adam_apply),
+    "adamax": (adamax_init, adamax_apply),
+    "adagrad": (adagrad_init, adagrad_apply),
+    "adadelta": (adadelta_init, adadelta_apply),
+}
+
+
+def names() -> Tuple[str, ...]:
+    return tuple(_RULES)
+
+
+def make(name: str, **hyperparams: Any) -> ShardRule:
+    """Bind hyperparameters, returning a jit-friendly (init, apply) pair."""
+    try:
+        init, apply = _RULES[name]
+    except KeyError:
+        raise ValueError(f"unknown rule {name!r}; have {sorted(_RULES)}") from None
+    bound = functools.partial(apply, **hyperparams) if hyperparams else apply
+    return ShardRule(init=init, apply=bound)

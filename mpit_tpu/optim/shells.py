@@ -1,0 +1,183 @@
+"""Client-side shells for server-stateful rules, and single-worker mode.
+
+**RuleShell** (reference BiCNN/optim-{rmsprop,adam,adamax,adagrad,
+adadelta}.lua): in 'global' mode the client ships *raw* gradients — every
+step when su==1, else accumulated and shipped on every su-th step — and the
+server applies the actual optimizer rule to its shard
+(mpit_tpu.optim.rules / reference BiCNN/pserver.lua:123-197).  Between syncs
+the local params do not move (reference optim-adam.lua:41 "do nothing
+here").  RMSProp additionally has a 'local' mode where the client applies
+centered-RMSProp itself and ships the *update* for the server to plain-add
+(reference optim-rmsprop.lua:48-65,76-92).
+
+**SingleWorker** (reference BiCNN/optim-*-single.lua, BiCNN/optim-msgd.lua):
+one worker runs the full optimizer locally — the same
+:mod:`mpit_tpu.optim.rules` math with plain bias correction — then pushes
+the whole parameter vector so the server acts as a parameter mirror for the
+tester rank (reference optim-adam-single.lua:35-36).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpit_tpu.optim import rules as rules_mod
+from mpit_tpu.optim.client_api import ParamClientAPI
+from mpit_tpu.optim.msgd import MSGDConfig, msgd_init, msgd_step
+
+
+class RuleShell:
+    """Accumulate-and-ship client for server-side optimizer rules."""
+
+    def __init__(
+        self,
+        value_and_grad_fn: Callable[..., Tuple[jnp.ndarray, jnp.ndarray]],
+        pclient: ParamClientAPI,
+        *,
+        su: int = 1,
+        mode: str = "global",
+        # 'local'-mode RMSProp hyperparameters (reference optim-rmsprop.lua):
+        lr: float = 1e-2,
+        decay: float = 0.95,
+        momentum: float = 0.9,
+        epsilon: float = 1e-4,
+    ):
+        if su < 1:
+            raise ValueError("su must be >= 1")
+        if mode not in ("global", "local"):
+            raise ValueError(f"mode must be 'global' or 'local', got {mode!r}")
+        self.pc = pclient
+        self.su = su
+        self.mode = mode
+        self.k = 0
+        self.dusync = 0.0
+        self._started = False
+        self._vgf = jax.jit(value_and_grad_fn)
+
+        if mode == "local":
+            # Client-side centered RMSProp producing an additive update.
+            rule = rules_mod.make(
+                "rmsprop", lr=lr, decay=decay, momentum=momentum, epsilon=epsilon
+            )
+
+            def _local(w, accum, rstate, *args):
+                loss, g = value_and_grad_fn(w, *args)
+                w_new, rstate = rule.apply(w, g, rstate)
+                update = w_new - w  # the shipped quantity (reference :59-60)
+                return loss, update, accum + update, rstate
+
+            self._local = jax.jit(_local)
+            self._rule = rule
+
+    def start(self, w: jnp.ndarray) -> jnp.ndarray:
+        self.w_host = np.array(w, dtype=np.float32)
+        self.grad_host = np.zeros_like(self.w_host)
+        self.accum = jnp.zeros_like(w)
+        if self.mode == "local":
+            self.rstate = self._rule.init(w)
+        self.pc.start(self.w_host, self.grad_host)
+        self._started = True
+        return w
+
+    def _sync(self, payload: jnp.ndarray) -> jnp.ndarray:
+        np.copyto(self.grad_host, np.asarray(payload))
+        self.pc.async_send_grad()
+        self.pc.async_recv_param()
+        t0 = time.monotonic()
+        self.pc.wait()
+        self.dusync += time.monotonic() - t0
+        return jnp.asarray(self.w_host)
+
+    def step(self, w: jnp.ndarray, *fn_args: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        assert self._started, "call start(w) first"
+        if self.mode == "global":
+            loss, g = self._vgf(w, *fn_args)
+            if self.su == 1:
+                w = self._sync(g)
+            else:
+                self.accum = self.accum + g
+                if self.k % self.su == 0:
+                    w = self._sync(self.accum)
+                    self.accum = jnp.zeros_like(self.accum)
+                # else: params do not move between syncs (reference :41)
+        else:  # local-mode RMSProp
+            loss, update, accum, self.rstate = self._local(
+                w, self.accum, self.rstate, *fn_args
+            )
+            if self.su == 1:
+                w = self._sync(update)
+            elif self.k % self.su == 0:
+                w = self._sync(accum)
+                self.accum = jnp.zeros_like(accum)
+            else:
+                self.accum = accum
+                w = w + update  # move locally (reference :63)
+        self.k += 1
+        return w, loss
+
+    def stop(self) -> None:
+        if self._started:
+            self.pc.stop()
+
+
+class SingleWorker:
+    """Full local optimizer + whole-param push (server as mirror)."""
+
+    def __init__(
+        self,
+        value_and_grad_fn: Callable[..., Tuple[jnp.ndarray, jnp.ndarray]],
+        pclient: ParamClientAPI,
+        *,
+        rule: str = "adam",
+        **hyperparams: Any,
+    ):
+        self.pc = pclient
+        self._started = False
+        if rule == "msgd":
+            cfg = MSGDConfig(**hyperparams)
+            self._kind = "msgd"
+
+            def _step(w, state, *args):
+                return msgd_step(value_and_grad_fn, w, state, cfg, *args)
+
+            self._step_fn = jax.jit(_step)
+            self._init_fn = msgd_init
+        else:
+            # Single-worker bias correction uses the plain exponent t
+            # (reference optim-adam-single.lua:28-30), hence step_div=None.
+            bound = rules_mod.make(rule, **hyperparams)
+            self._kind = "rule"
+
+            def _step(w, state, *args):
+                loss, g = value_and_grad_fn(w, *args)
+                w_new, state = bound.apply(w, g, state)
+                return w_new, state, loss
+
+            self._step_fn = jax.jit(_step)
+            self._init_fn = bound.init
+
+    def start(self, w: jnp.ndarray) -> jnp.ndarray:
+        self.state = self._init_fn(w)
+        self.w_host = np.array(w, dtype=np.float32)
+        self.grad_host = np.zeros_like(self.w_host)
+        self.pc.start(self.w_host, self.grad_host)
+        self._started = True
+        return w
+
+    def step(self, w: jnp.ndarray, *fn_args: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        assert self._started, "call start(w) first"
+        w, self.state, loss = self._step_fn(w, self.state, *fn_args)
+        # Push the whole parameter vector (reference optim-adam-single.lua:35-36).
+        np.copyto(self.w_host, np.asarray(w))
+        self.pc.async_send_param()
+        self.pc.wait()
+        return w, loss
+
+    def stop(self) -> None:
+        if self._started:
+            self.pc.stop()

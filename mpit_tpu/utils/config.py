@@ -82,16 +82,20 @@ class Config:
         BiCNN/plaunch.lua:70).
         """
         parser = argparse.ArgumentParser()
+        exposed = []
         for key, default in self._data.items():
             flag = "--" + key
             if isinstance(default, bool):
                 parser.add_argument(flag, type=_parse_bool, default=default)
             elif default is None:
                 parser.add_argument(flag, type=str, default=None)
-            else:
+            elif isinstance(default, (int, float, str)):
                 parser.add_argument(flag, type=type(default), default=default)
+            else:
+                continue  # non-scalar defaults are not CLI-settable
+            exposed.append(key)
         ns = parser.parse_args(argv)
-        return self.merged(vars(ns))
+        return self.merged({k: getattr(ns, k) for k in exposed})
 
 
 def _parse_bool(text: str) -> bool:

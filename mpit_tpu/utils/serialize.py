@@ -63,6 +63,11 @@ def decode_array(blob: bytes | memoryview, out: np.ndarray | None = None) -> np.
     flat = np.frombuffer(view, dtype=dtype, offset=offset)
     array = flat.reshape(shape)
     if out is not None:
+        if out.shape != array.shape or out.dtype != array.dtype:
+            raise ValueError(
+                f"payload shape/dtype {array.shape}/{array.dtype} does not "
+                f"match out buffer {out.shape}/{out.dtype}"
+            )
         np.copyto(out, array)
         return out
     return array.copy()  # decouple from the transport buffer

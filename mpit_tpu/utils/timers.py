@@ -56,7 +56,8 @@ def trace_annotation(name: str) -> Iterator[None]:
     try:
         import jax.profiler as _prof
 
-        with _prof.TraceAnnotation(name):
-            yield
+        annotation = _prof.TraceAnnotation(name)
     except Exception:  # pragma: no cover - profiler unavailable
+        annotation = contextlib.nullcontext()
+    with annotation:
         yield

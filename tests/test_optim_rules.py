@@ -1,0 +1,211 @@
+"""Golden-value tests for the shard-update rules and msgd.
+
+Each rule is checked against an independent numpy re-derivation of the
+reference update equations (reference BiCNN/pserver.lua:123-197,
+asyncsgd/optim-msgd.lua) — not against the JAX code itself.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.optim import rules
+from mpit_tpu.optim.msgd import MSGDConfig, msgd_init, msgd_step
+
+RTOL = 1e-5
+
+
+def rollout(rule, p0, grads):
+    state = rule.init(jnp.asarray(p0))
+    p = jnp.asarray(p0)
+    apply = jax.jit(rule.apply)
+    for g in grads:
+        p, state = apply(p, jnp.asarray(g), state)
+    return np.asarray(p), state
+
+
+@pytest.fixture
+def grads(rng):
+    return [rng.normal(size=5).astype(np.float32) for _ in range(4)]
+
+
+@pytest.fixture
+def p0(rng):
+    return rng.normal(size=5).astype(np.float32)
+
+
+class TestPlainAdd:
+    def test_accumulates(self, p0, grads):
+        p, _ = rollout(rules.make("add"), p0, grads)
+        np.testing.assert_allclose(p, p0 + sum(grads), rtol=RTOL)
+
+
+class TestRMSProp:
+    def test_matches_numpy(self, p0, grads):
+        lr, decay, momentum, eps = 0.01, 0.9, 0.5, 1e-4
+        p, _ = rollout(
+            rules.make("rmsprop", lr=lr, decay=decay, momentum=momentum, epsilon=eps),
+            p0,
+            grads,
+        )
+        # Independent simulator: centered RMSProp with momentum.
+        ga = np.zeros(5, np.float64)
+        gsa = np.zeros(5, np.float64)
+        upd = np.zeros(5, np.float64)
+        ref = p0.astype(np.float64)
+        for g in grads:
+            ga = decay * ga + (1 - decay) * g
+            gsa = decay * gsa + (1 - decay) * g * g
+            rms = np.sqrt(gsa - ga * ga + eps)
+            upd = momentum * upd - lr * g / rms
+            ref = ref + upd
+        np.testing.assert_allclose(p, ref, rtol=1e-4)
+
+
+class TestAdam:
+    def test_single_mode_matches_numpy(self, p0, grads):
+        lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+        p, state = rollout(
+            rules.make("adam", lr=lr, beta1=b1, beta2=b2, epsilon=eps), p0, grads
+        )
+        m = np.zeros(5, np.float64)
+        v = np.zeros(5, np.float64)
+        ref = p0.astype(np.float64)
+        for t, g in enumerate(grads, start=1):
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            lr_t = lr * np.sqrt(1 - b2**t) / (1 - b1**t)
+            ref = ref - lr_t * m / (np.sqrt(v) + eps)
+        np.testing.assert_allclose(p, ref, rtol=1e-4)
+        assert int(state["t"]) == len(grads)
+
+    def test_server_mode_step_div(self, p0, grads):
+        """Server mode: bias-correction exponent floor(t/step_div)+1
+        (reference BiCNN/pserver.lua:151-153)."""
+        lr, b1, b2, eps, sd = 1e-3, 0.9, 0.999, 1e-8, 2
+        p, _ = rollout(
+            rules.make("adam", lr=lr, beta1=b1, beta2=b2, epsilon=eps, step_div=sd),
+            p0,
+            grads,
+        )
+        m = np.zeros(5, np.float64)
+        v = np.zeros(5, np.float64)
+        ref = p0.astype(np.float64)
+        for t, g in enumerate(grads, start=1):
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            e = t // sd + 1
+            lr_t = lr * np.sqrt(1 - b2**e) / (1 - b1**e)
+            ref = ref - lr_t * m / (np.sqrt(v) + eps)
+        np.testing.assert_allclose(p, ref, rtol=1e-4)
+
+
+class TestAdamax:
+    def test_matches_numpy(self, p0, grads):
+        lr, b1, b2, eps = 2e-3, 0.9, 0.999, 1e-8
+        p, _ = rollout(
+            rules.make("adamax", lr=lr, beta1=b1, beta2=b2, epsilon=eps), p0, grads
+        )
+        m = np.zeros(5, np.float64)
+        u = np.zeros(5, np.float64)
+        ref = p0.astype(np.float64)
+        for t, g in enumerate(grads, start=1):
+            m = b1 * m + (1 - b1) * g
+            u = np.maximum(b2 * u, np.abs(g) + eps)  # eps inside the max
+            ref = ref - (lr / (1 - b1**t)) * m / u
+        np.testing.assert_allclose(p, ref, rtol=1e-4)
+
+
+class TestAdagrad:
+    def test_matches_numpy(self, p0, grads):
+        lr, lrd, eps = 1e-2, 0.1, 1e-10
+        p, _ = rollout(rules.make("adagrad", lr=lr, lrd=lrd, epsilon=eps), p0, grads)
+        var = np.zeros(5, np.float64)
+        ref = p0.astype(np.float64)
+        for k, g in enumerate(grads):
+            clr = lr / (1 + k * lrd)
+            var = var + g * g
+            ref = ref - clr * g / (np.sqrt(var) + eps)
+        np.testing.assert_allclose(p, ref, rtol=1e-4)
+
+
+class TestAdadelta:
+    def test_matches_numpy(self, p0, grads):
+        lr, rho, eps = 1.0, 0.9, 1e-6
+        p, _ = rollout(rules.make("adadelta", lr=lr, rho=rho, epsilon=eps), p0, grads)
+        var = np.zeros(5, np.float64)
+        acc = np.zeros(5, np.float64)
+        ref = p0.astype(np.float64)
+        for g in grads:
+            var = rho * var + (1 - rho) * g * g
+            delta = np.sqrt(acc + eps) / np.sqrt(var + eps) * g
+            ref = ref - lr * delta
+            acc = rho * acc + (1 - rho) * delta * delta
+        np.testing.assert_allclose(p, ref, rtol=1e-4)
+
+
+class TestRegistry:
+    def test_names(self):
+        assert set(rules.names()) == {
+            "add",
+            "rmsprop",
+            "adam",
+            "adamax",
+            "adagrad",
+            "adadelta",
+        }
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            rules.make("nope")
+
+
+def quadratic_vgf(w, target):
+    """loss = 0.5*||w-target||², grad = w-target."""
+    loss = 0.5 * jnp.sum((w - target) ** 2)
+    return loss, w - target
+
+
+class TestMSGD:
+    def test_no_momentum_is_plain_sgd(self, p0):
+        cfg = MSGDConfig(lr=0.1)
+        target = jnp.zeros(5)
+        w = jnp.asarray(p0)
+        state = msgd_init(w)
+        w, state, _ = msgd_step(quadratic_vgf, w, state, cfg, target)
+        np.testing.assert_allclose(np.asarray(w), p0 - 0.1 * p0, rtol=RTOL)
+
+    def test_full_semantics_vs_numpy(self, p0):
+        """Lookahead ordering + momentum ramp + lr decay + l2wd, 5 steps."""
+        cfg = MSGDConfig(
+            lr=0.1, lrd=0.01, lrp=2.0, mom=0.9, mommax=0.95, momdecay=10.0, l2wd=1e-3
+        )
+        target = np.zeros(5, np.float32)
+        w = jnp.asarray(p0)
+        state = msgd_init(w)
+        step = jax.jit(
+            lambda w, s, t: msgd_step(quadratic_vgf, w, s, cfg, t)
+        )
+        for _ in range(5):
+            w, state, _ = step(w, state, jnp.asarray(target))
+
+        # Independent reference-order simulator (optim-msgd.lua:20-40).
+        ref = p0.astype(np.float64)
+        vt = np.zeros(5, np.float64)
+        for k in range(5):
+            mom = min(cfg.mommax, 1 - 0.5 / (1 + k / cfg.momdecay))
+            vt = mom * vt
+            ref = ref + vt
+            g = (ref - target) + cfg.l2wd * ref
+            clr = cfg.lr / (1 + k * cfg.lrd) ** cfg.lrp
+            ref = ref - clr * g
+            vt = vt - clr * g
+        np.testing.assert_allclose(np.asarray(w), ref, rtol=1e-4)
+
+    def test_momentum_ramp_capped(self):
+        from mpit_tpu.optim.msgd import _effective_momentum
+
+        cfg = MSGDConfig(mom=0.5, mommax=0.7, momdecay=1.0)
+        m = _effective_momentum(cfg, jnp.asarray(10**6, jnp.int32))
+        assert float(m) == pytest.approx(0.7)
