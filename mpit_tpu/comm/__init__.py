@@ -1,0 +1,27 @@
+"""L0 — transports: typed nonblocking message passing between role processes.
+
+The reference's L0 is a generated Lua<->MPI C binding exposing the MPI-2
+surface with zero-copy access to tensor storages (reference mpiT.c,
+lua-mpi.h:70-78, mpifuncs.c, readspec.py).  Messages are addressed by
+``(rank, tag)`` and driven through nonblocking Isend/Irecv/Iprobe/Test/
+Cancel (reference init.lua:40-102).
+
+Here the same contract — nonblocking, (rank, tag)-addressed, zero-copy into
+caller buffers, cancellable — is provided by three backends:
+
+- :class:`mpit_tpu.comm.local.LocalTransport`: in-process mailboxes for
+  tests and single-process multi-role runs (the claunch analog).
+- :class:`mpit_tpu.comm.shm.ShmTransport`: the native C++ shared-memory
+  ring transport (mpit_tpu/comm/native/) for same-host multi-process runs —
+  the analog of how the reference is actually exercised (``mpirun -np N``
+  on one host, reference README.md:28-31); ctypes bindings are generated
+  from JSON specs, mirroring the reference's readspec.py codegen.
+- :mod:`mpit_tpu.comm.collectives`: the on-ICI path — shard exchange
+  expressed as XLA collectives (ppermute/psum/all_gather) under shard_map,
+  for the gang-scheduled synchronous modes where devices run in lockstep.
+"""
+
+from mpit_tpu.comm.transport import Handle, Transport
+from mpit_tpu.comm.local import LocalRouter, LocalTransport
+
+__all__ = ["Transport", "Handle", "LocalRouter", "LocalTransport"]

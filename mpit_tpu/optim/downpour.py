@@ -64,12 +64,22 @@ class Downpour:
 
     def start(self, w: jnp.ndarray) -> jnp.ndarray:
         """Register buffers with the client; first client seeds servers."""
-        self.w_host = np.array(w, dtype=np.float32)
+        self.w_host = np.array(w)  # dtype-preserving host mirror
         self.grad_host = np.zeros_like(self.w_host)
         self.accum = jnp.zeros_like(w)
         self.pc.start(self.w_host, self.grad_host)
         self._started = True
         return w
+
+    def _sync(self, payload: jnp.ndarray) -> jnp.ndarray:
+        """Ship ``payload`` as the grad, fetch fresh params, time the wait."""
+        np.copyto(self.grad_host, np.asarray(payload))
+        self.pc.async_send_grad()
+        self.pc.async_recv_param()
+        t0 = time.monotonic()
+        self.pc.wait()
+        self.dusync += time.monotonic() - t0
+        return jnp.asarray(self.w_host)
 
     def step(self, w: jnp.ndarray, *fn_args: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
         assert self._started, "call start(w) first"
@@ -77,23 +87,10 @@ class Downpour:
         loss, dfdx, accum, w_local = self._local(w, self.accum, k, *fn_args)
 
         if self.su == 1:
-            np.copyto(self.grad_host, np.asarray(dfdx))
-            self.pc.async_send_grad()
-            self.pc.async_recv_param()
-            t0 = time.monotonic()
-            self.pc.wait()
-            self.dusync += time.monotonic() - t0
-            w = jnp.asarray(self.w_host)
+            w = self._sync(dfdx)
         elif self.k % self.su == 0:
-            # Ship the accumulated delta, fetch fresh params, clear accum.
-            np.copyto(self.grad_host, np.asarray(accum))
-            self.pc.async_send_grad()
-            self.pc.async_recv_param()
-            t0 = time.monotonic()
-            self.pc.wait()
-            self.dusync += time.monotonic() - t0
+            w = self._sync(accum)
             self.accum = jnp.zeros_like(accum)
-            w = jnp.asarray(self.w_host)
         else:
             self.accum = accum
             w = w_local  # move locally between syncs (reference :44)

@@ -78,9 +78,9 @@ class EAMSGD:
         self._steps = 0  # mirrors state["k"] host-side for the su modulus
         # Dedicated comm copies: recv target for w*, send source for sug
         # (reference :49-53 allocates suw/sug and retargets the client).
-        self.center_host = np.zeros(np.shape(w), dtype=np.float32)
+        self.center_host = np.zeros_like(np.asarray(w))
         self.sug_host = np.zeros_like(self.center_host)
-        self.pc.start(np.array(w, dtype=np.float32), self.sug_host)
+        self.pc.start(np.array(w), self.sug_host)
         self.pc.reset(self.center_host, self.sug_host)
         self._started = True
         return w

@@ -28,12 +28,12 @@ be *plain-added*, or raw gradients for the server-side rules to consume.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax.numpy as jnp
 
 State = Dict[str, Any]
-Rule = Tuple[Callable[..., State], Callable[..., Tuple[jnp.ndarray, State]]]
 
 
 class ShardRule(NamedTuple):
@@ -229,10 +229,25 @@ def names() -> Tuple[str, ...]:
 
 
 def make(name: str, **hyperparams: Any) -> ShardRule:
-    """Bind hyperparameters, returning a jit-friendly (init, apply) pair."""
+    """Bind hyperparameters, returning a jit-friendly (init, apply) pair.
+
+    Hyperparameter names are validated eagerly so a typo fails here, at the
+    config site, rather than at the first jitted apply."""
     try:
         init, apply = _RULES[name]
     except KeyError:
         raise ValueError(f"unknown rule {name!r}; have {sorted(_RULES)}") from None
-    bound = functools.partial(apply, **hyperparams) if hyperparams else apply
-    return ShardRule(init=init, apply=bound)
+    if hyperparams:
+        valid = {
+            p.name
+            for p in inspect.signature(apply).parameters.values()
+            if p.kind is inspect.Parameter.KEYWORD_ONLY
+        }
+        unknown = set(hyperparams) - valid
+        if unknown:
+            raise ValueError(
+                f"rule {name!r} has no hyperparameter(s) {sorted(unknown)}; "
+                f"valid: {sorted(valid)}"
+            )
+        apply = functools.partial(apply, **hyperparams)
+    return ShardRule(init=init, apply=apply)

@@ -57,7 +57,8 @@ class RuleShell:
         self.k = 0
         self.dusync = 0.0
         self._started = False
-        self._vgf = jax.jit(value_and_grad_fn)
+        if mode == "global":
+            self._vgf = jax.jit(value_and_grad_fn)
 
         if mode == "local":
             # Client-side centered RMSProp producing an additive update.
@@ -75,7 +76,7 @@ class RuleShell:
             self._rule = rule
 
     def start(self, w: jnp.ndarray) -> jnp.ndarray:
-        self.w_host = np.array(w, dtype=np.float32)
+        self.w_host = np.array(w)  # dtype-preserving host mirror
         self.grad_host = np.zeros_like(self.w_host)
         self.accum = jnp.zeros_like(w)
         if self.mode == "local":
@@ -140,7 +141,6 @@ class SingleWorker:
         self._started = False
         if rule == "msgd":
             cfg = MSGDConfig(**hyperparams)
-            self._kind = "msgd"
 
             def _step(w, state, *args):
                 return msgd_step(value_and_grad_fn, w, state, cfg, *args)
@@ -151,7 +151,6 @@ class SingleWorker:
             # Single-worker bias correction uses the plain exponent t
             # (reference optim-adam-single.lua:28-30), hence step_div=None.
             bound = rules_mod.make(rule, **hyperparams)
-            self._kind = "rule"
 
             def _step(w, state, *args):
                 loss, g = value_and_grad_fn(w, *args)
@@ -163,7 +162,7 @@ class SingleWorker:
 
     def start(self, w: jnp.ndarray) -> jnp.ndarray:
         self.state = self._init_fn(w)
-        self.w_host = np.array(w, dtype=np.float32)
+        self.w_host = np.array(w)  # dtype-preserving host mirror
         self.grad_host = np.zeros_like(self.w_host)
         self.pc.start(self.w_host, self.grad_host)
         self._started = True

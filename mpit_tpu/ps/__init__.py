@@ -1,0 +1,24 @@
+"""L2 — the sharded asynchronous parameter-server protocol.
+
+The reference implements one pServer process per shard with per-client
+service coroutines (recvinit / recvparam / sendparam / recvgrad / recvstop,
+reference asyncsgd/pserver.lua:131-157) and a pClient that splits the flat
+parameter vector across servers and drives async shard transfers (reference
+asyncsgd/pclient.lua:84-179), over an 8-tag wire protocol (reference
+asyncsgd/init.lua:3-10).
+
+This package is the TPU-native rebuild: shards are device-HBM-resident JAX
+arrays updated by jitted shard rules (mpit_tpu.optim.rules); service loops
+are generator tasks on the cooperative scheduler (mpit_tpu.aio); transfers
+go through a pluggable Transport (mpit_tpu.comm).  The reference's
+deliberate lock-free stale reads (pserver.lua:74 "expect inconsistent
+read") become serve-latest-committed snapshots — JAX immutability gives the
+same algorithmic tolerance without torn reads.
+"""
+
+from mpit_tpu.ps.sharding import Shard, shard_layout
+from mpit_tpu.ps.client import ParamClient
+from mpit_tpu.ps.server import ParamServer
+from mpit_tpu.ps import tags
+
+__all__ = ["Shard", "shard_layout", "ParamClient", "ParamServer", "tags"]

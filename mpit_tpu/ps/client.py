@@ -1,0 +1,171 @@
+"""ParamClient — shards the flat parameter vector across servers and
+drives asynchronous shard transfers.
+
+Rebuild of reference asyncsgd/pclient.lua.  The client registers two host
+buffers (``param``, ``grad``) whose per-server contiguous slices are the
+transfer units (numpy views = the reference's zero-copy storage-offset
+views, pclient.lua:50-52).  Public surface mirrors pclient.lua:84-179:
+``start``, ``async_send_grad``, ``async_recv_param``, ``async_send_param``,
+``ping``, ``wait``, ``reset``, ``stop``.
+
+The comm-aware optimizers (mpit_tpu.optim.downpour/easgd/shells) drive this
+class through the ParamClientAPI protocol; device arrays stay in the
+optimizer layer — the client only ever touches the registered host mirrors.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, Generator, List, Optional
+
+import numpy as np
+
+from mpit_tpu.aio import LiveFlag, Scheduler, aio_recv, aio_send
+from mpit_tpu.comm.transport import Transport
+from mpit_tpu.ps import tags
+from mpit_tpu.ps.sharding import Shard, shard_layout
+from mpit_tpu.utils.logging import get_logger
+
+
+class ParamClient:
+    def __init__(
+        self,
+        rank: int,
+        server_ranks: list[int],
+        transport: Transport,
+        scheduler: Optional[Scheduler] = None,
+        seed_servers: bool = False,
+    ):
+        self.rank = rank
+        self.sranks = list(server_ranks)
+        self.transport = transport
+        self.sched = scheduler or Scheduler()
+        self.seed_servers = seed_servers  # this is the first client
+        self.live = LiveFlag()
+        self.log = get_logger("pclient", rank)
+        self.param: Optional[np.ndarray] = None
+        self.grad: Optional[np.ndarray] = None
+        self.shards: List[Shard] = []
+        self._started = False
+        # Per-server FIFO op chains: ops addressed to the same server run in
+        # issue order (a send_grad's ack completes before a later param
+        # request is sent), while different servers stay fully concurrent.
+        # Strictly stronger than the reference (which relies on coroutine
+        # spawn order for freshness, pclient.lua:84-109) — this removes the
+        # stale-own-write race without giving up cross-server overlap.
+        self._opq: Dict[int, Deque[Generator]] = {}
+        self._pump_live: Dict[int, bool] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, param: np.ndarray, grad: np.ndarray) -> None:
+        """Announce shard layout to every server; the first client seeds
+        the servers' shards from ``param`` (reference pclient.lua:111-129)."""
+        self._register(param, grad)
+        self.shards = shard_layout(len(param), len(self.sranks))
+        for srank, shard in zip(self.sranks, self.shards):
+            cinfo = np.asarray([shard.offset, shard.size], dtype=np.int64)
+            self.sched.spawn(
+                aio_send(self.transport, cinfo, srank, tags.INIT, live=self.live),
+                name=f"send_init:{srank}",
+            )
+        self.wait()
+        if self.seed_servers:
+            self.async_send_param()
+            self.wait()
+        self._started = True
+
+    def _register(self, param: np.ndarray, grad: np.ndarray) -> None:
+        # Dtype-agnostic: shards are element ranges; transports move bytes.
+        if not isinstance(param, np.ndarray) or not isinstance(grad, np.ndarray):
+            raise TypeError("param and grad must be numpy arrays (host mirrors)")
+        if param.ndim != 1 or grad.shape != param.shape or grad.dtype != param.dtype:
+            raise ValueError("param and grad must be 1-D with equal shape and dtype")
+        if not param.flags["C_CONTIGUOUS"] or not grad.flags["C_CONTIGUOUS"]:
+            raise ValueError("param and grad must be contiguous (zero-copy rule)")
+        self.param, self.grad = param, grad
+
+    def reset(self, param: np.ndarray, grad: np.ndarray) -> None:
+        """Retarget transfer buffers without re-announcing shards
+        (reference pclient.lua:138-151)."""
+        if self.shards and len(param) != self.shards[-1].end:
+            raise ValueError("reset buffers must keep the registered length")
+        self._register(param, grad)
+
+    # -- per-server transfer generators -------------------------------------
+
+    def _send_grad(self, srank: int, shard: Shard):
+        """Ship the grad slice, await the applied ack
+        (reference pclient.lua:48-58)."""
+        view = self.grad[shard.offset : shard.end]
+        yield from aio_send(self.transport, view, srank, tags.GRAD, live=self.live)
+        yield from aio_recv(self.transport, srank, tags.GRAD_ACK, live=self.live)
+
+    def _recv_param(self, srank: int, shard: Shard):
+        """Request-to-read header, then receive into the param slice
+        (reference pclient.lua:72-82)."""
+        yield from aio_send(
+            self.transport, tags.EMPTY, srank, tags.PARAM_REQ, live=self.live
+        )
+        out = self.param[shard.offset : shard.end]
+        yield from aio_recv(self.transport, srank, tags.PARAM, live=self.live, out=out)
+
+    def _send_param(self, srank: int, shard: Shard):
+        """Whole-shard write, await ack (reference pclient.lua:60-70)."""
+        view = self.param[shard.offset : shard.end]
+        yield from aio_send(self.transport, view, srank, tags.PARAM_PUSH, live=self.live)
+        yield from aio_recv(self.transport, srank, tags.PARAM_PUSH_ACK, live=self.live)
+
+    # -- public async API (reference pclient.lua:84-109) --------------------
+
+    def _enqueue(self, srank: int, gen: Generator, name: str) -> None:
+        queue = self._opq.setdefault(srank, deque())
+        queue.append(gen)
+        if not self._pump_live.get(srank, False):
+            self._pump_live[srank] = True
+            self.sched.spawn(self._pump(srank), name=f"pump:{srank}:{name}")
+
+    def _pump(self, srank: int):
+        """Run this server's queued ops strictly in order."""
+        queue = self._opq[srank]
+        try:
+            while queue:
+                op = queue.popleft()
+                yield from op
+        finally:
+            self._pump_live[srank] = False
+
+    def async_send_grad(self) -> None:
+        for srank, shard in zip(self.sranks, self.shards):
+            self._enqueue(srank, self._send_grad(srank, shard), "send_grad")
+
+    def async_recv_param(self) -> None:
+        for srank, shard in zip(self.sranks, self.shards):
+            self._enqueue(srank, self._recv_param(srank, shard), "recv_param")
+
+    def async_send_param(self) -> None:
+        for srank, shard in zip(self.sranks, self.shards):
+            self._enqueue(srank, self._send_param(srank, shard), "send_param")
+
+    def ping(self, n: int = 1) -> None:
+        """Single-step I/O progress to overlap with compute
+        (reference pclient.lua:131-136)."""
+        for _ in range(n):
+            self.sched.ping()
+
+    def wait(self) -> None:
+        self.sched.wait()
+
+    # -- shutdown (reference pclient.lua:153-164) ---------------------------
+
+    def stop(self) -> None:
+        # Chained per server, so the stop cannot overtake in-flight ops
+        # (the reference's drain-then-stop care, init.lua:50-58, README:71).
+        for srank in self.sranks:
+            self._enqueue(
+                srank,
+                aio_send(self.transport, tags.EMPTY, srank, tags.STOP, live=self.live),
+                "send_stop",
+            )
+        self.wait()
+        self.live.stop()

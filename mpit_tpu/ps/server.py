@@ -1,0 +1,182 @@
+"""ParamServer — one process/role per shard, service loops per client.
+
+Rebuild of reference asyncsgd/pserver.lua (plus the BiCNN variant's
+server-side optimizer state, BiCNN/pserver.lua:50-83) with TPU-native
+mechanics:
+
+- The shard and its optimizer state are **device-HBM-resident JAX arrays**;
+  every incoming gradient triggers one jitted ``rule.apply`` XLA program
+  (the analog of the in-place ``p:add(g)`` / server-side Adam etc.,
+  reference pserver.lua:83, BiCNN/pserver.lua:123-197).
+- Service loops are generator tasks on the cooperative scheduler — the
+  direct analog of the reference's per-client coroutines
+  (pserver.lua:131-157): ``recv_init``, one-shot ``recv_param`` from the
+  seeding client, perpetual ``send_param`` / ``recv_grad`` loops, and the
+  stop counter (pserver.lua:115-129).
+- The reference's deliberate lock-free read ("expect inconsistent read",
+  pserver.lua:74) maps to serve-latest-committed: ``send_param`` snapshots
+  the current immutable device array — writers are never quiesced, and no
+  torn read is possible.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpit_tpu.aio import LiveFlag, Scheduler, aio_recv, aio_send
+from mpit_tpu.comm.transport import Transport
+from mpit_tpu.optim.rules import ShardRule, make as make_rule
+from mpit_tpu.ps import tags
+from mpit_tpu.utils.logging import get_logger
+
+
+class ParamServer:
+    def __init__(
+        self,
+        rank: int,
+        client_ranks: list[int],
+        transport: Transport,
+        rule: ShardRule | str = "add",
+        scheduler: Optional[Scheduler] = None,
+        dtype=np.float32,
+        single_mode: bool = False,
+    ):
+        self.rank = rank
+        self.cranks = list(client_ranks)
+        self.transport = transport
+        self.rule = make_rule(rule) if isinstance(rule, str) else rule
+        self.sched = scheduler or Scheduler()
+        self.dtype = np.dtype(dtype)
+        self.single_mode = single_mode  # perpetual param-push service
+        self.live = LiveFlag()
+        self.log = get_logger("pserver", rank)
+
+        self.offset = -1
+        self.size = -1
+        self.param: Optional[jnp.ndarray] = None  # device-resident shard
+        self.rule_state = None
+        self.grad_bufs: Dict[int, np.ndarray] = {}  # host recv staging, per client
+        self._param_staging: Optional[np.ndarray] = None
+        self._stopped_clients = 0
+        self._apply = jax.jit(self.rule.apply)
+        self.grads_applied = 0
+        self.params_served = 0
+
+    # -- service generators (reference pserver.lua coroutines) --------------
+
+    def _recv_init(self, crank: int):
+        """Receive [offset, size]; allocate shard state (reference :33-57)."""
+        payload = yield from aio_recv(self.transport, crank, tags.INIT, live=self.live)
+        if payload is None:
+            return
+        offset, size = (int(x) for x in np.frombuffer(payload, dtype=np.int64))
+        if self.offset == -1:
+            self.offset, self.size = offset, size
+            self.param = jnp.zeros((size,), dtype=self.dtype)
+            self.rule_state = self.rule.init(self.param)
+            self._param_staging = np.zeros((size,), dtype=self.dtype)
+        else:
+            # All clients must agree on this server's shard (reference :87-88).
+            assert (self.offset, self.size) == (offset, size), (
+                f"client {crank} announced shard ({offset},{size}) but server "
+                f"{self.rank} already holds ({self.offset},{self.size})"
+            )
+        self.grad_bufs[crank] = np.zeros((size,), dtype=self.dtype)
+
+    def _recv_param(self, crank: int, once: bool = True):
+        """Whole-shard write from a client: one-shot seeding from the first
+        client (reference :92-102) or perpetual in single mode (the
+        BiCNN recvparam_always service, BiCNN/pserver.lua:220-232)."""
+        while self.live.on:
+            got = yield from aio_recv(
+                self.transport, crank, tags.PARAM_PUSH,
+                live=self.live, out=self._param_staging,
+            )
+            if got is None:
+                return
+            self.param = jnp.asarray(self._param_staging)
+            yield from aio_send(
+                self.transport, tags.EMPTY, crank, tags.PARAM_PUSH_ACK, live=self.live
+            )
+            if once:
+                return
+
+    def _send_param(self, crank: int):
+        """Loop: await 0-byte read request, send current snapshot
+        (reference :59-72)."""
+        while self.live.on:
+            got = yield from aio_recv(
+                self.transport, crank, tags.PARAM_REQ, live=self.live
+            )
+            if got is None:
+                return
+            if self.live.io:
+                # Serve-latest-committed: np.asarray snapshots the current
+                # immutable device array (device->host copy).
+                snapshot = np.asarray(self.param)
+                yield from aio_send(
+                    self.transport, snapshot, crank, tags.PARAM, live=self.live
+                )
+                self.params_served += 1
+
+    def _recv_grad(self, crank: int):
+        """Loop: receive gradient, apply the shard rule, ack
+        (reference :75-90 — the server hot loop)."""
+        gbuf = self.grad_bufs[crank]
+        while self.live.on:
+            got = yield from aio_recv(
+                self.transport, crank, tags.GRAD, live=self.live, out=gbuf
+            )
+            if got is None:
+                return
+            self.param, self.rule_state = self._apply(
+                self.param, jnp.asarray(gbuf), self.rule_state
+            )
+            self.grads_applied += 1
+            if self.live.on:
+                yield from aio_send(
+                    self.transport, tags.EMPTY, crank, tags.GRAD_ACK, live=self.live
+                )
+
+    def _recv_stop(self, crank: int):
+        """Count stop signals; all clients stopped => shut down I/O
+        (reference :115-129)."""
+        got = yield from aio_recv(self.transport, crank, tags.STOP, live=self.live)
+        if got is None:
+            return
+        self._stopped_clients += 1
+        if self._stopped_clients == len(self.cranks):
+            self.live.stop()
+
+    # -- orchestration (reference pserver.lua:131-157) ----------------------
+
+    def start(self) -> None:
+        """Run the server to completion (returns after the stop protocol)."""
+        # Phase 1: shard announcements from every client.
+        for crank in self.cranks:
+            self.sched.spawn(self._recv_init(crank), name=f"recv_init:{crank}")
+        self.sched.wait()
+        # Phase 2: parameter seeding from the first client only
+        # (init once & only once, reference README:64-67).
+        seeder = self.cranks[0]
+        self.sched.spawn(self._recv_param(seeder, once=True), name="seed_param")
+        self.sched.wait()
+        # Phase 3: perpetual services per client + stop counters.
+        for crank in self.cranks:
+            self.sched.spawn(self._recv_stop(crank), name=f"recv_stop:{crank}")
+            self.sched.spawn(self._recv_grad(crank), name=f"recv_grad:{crank}")
+            self.sched.spawn(self._send_param(crank), name=f"send_param:{crank}")
+            if self.single_mode:
+                self.sched.spawn(
+                    self._recv_param(crank, once=False), name=f"recv_param:{crank}"
+                )
+        self.sched.wait()
+        self.log.debug(
+            "stopped: %d grads applied, %d params served",
+            self.grads_applied,
+            self.params_served,
+        )

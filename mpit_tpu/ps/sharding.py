@@ -1,0 +1,43 @@
+"""Flat-parameter shard layout across server ranks.
+
+Mirrors the reference's split exactly (reference asyncsgd/pclient.lua:
+111-129): the flat vector of length ``plong`` is cut into
+``floor(plong / nservers)``-sized chunks, one per server in rank order,
+with the **last** server taking the remainder.  Offsets here are 0-based
+(the reference is 1-based Lua; its off-by-one history is README:66-70 —
+0-based indexing removes that class of bug).
+
+On the trainer side the flat vector is the ``ravel_pytree`` of the model
+parameters (the getParameters() analog, reference goot.lua:33-36); shards
+are then contiguous slices, which keeps every transfer a single
+zero-copy view (reference pclient.lua:50-52 uses storage-offset views the
+same way).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+
+class Shard(NamedTuple):
+    offset: int
+    size: int
+
+    @property
+    def end(self) -> int:
+        return self.offset + self.size
+
+
+def shard_layout(plong: int, nservers: int) -> List[Shard]:
+    if nservers < 1:
+        raise ValueError("need at least one server")
+    if plong < nservers:
+        raise ValueError(
+            f"cannot shard {plong} parameters across {nservers} servers "
+            "(each server needs a nonempty shard)"
+        )
+    base = plong // nservers
+    shards = [Shard(i * base, base) for i in range(nservers - 1)]
+    last_offset = (nservers - 1) * base
+    shards.append(Shard(last_offset, plong - last_offset))
+    return shards

@@ -1,0 +1,19 @@
+"""Wire-protocol tags (analog of reference asyncsgd/init.lua:3-10).
+
+Eight channels, renamed by direction and purpose rather than the
+reference's server-perspective naming.  0-byte messages serve as the
+rendezvous conventions the reference relies on: PARAM_REQ is the "header"
+a client sends to request a shard read (reference pclient.lua:74-75 ->
+pserver.lua:100-101); *_ACK are the "tail" completion acks after writes
+(reference pserver.lua:85-86, pclient.lua:55-56)."""
+
+INIT = 1  # client -> server: int64 [offset, size] shard announcement
+GRAD = 2  # client -> server: gradient/delta bytes for the shard
+GRAD_ACK = 3  # server -> client: 0-byte ack after the update is applied
+PARAM_REQ = 4  # client -> server: 0-byte request-to-read header
+PARAM = 5  # server -> client: current shard snapshot
+PARAM_PUSH = 6  # client -> server: whole-shard parameter write
+PARAM_PUSH_ACK = 7  # server -> client: 0-byte ack after the write lands
+STOP = 8  # client -> server: 0-byte graceful-shutdown signal
+
+EMPTY = b""  # the canonical 0-byte payload

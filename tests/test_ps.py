@@ -1,0 +1,244 @@
+"""Integration tests: ParamServer + ParamClient over the in-process
+transport — the analog of the reference's mpirun-on-one-host test mode
+(SURVEY.md section 4), with real assertions.
+
+Topology helpers run each server's blocking event loop on its own thread
+(the per-rank process analog) while clients drive from the test thread.
+"""
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.comm.local import LocalRouter
+from mpit_tpu.optim import rules
+from mpit_tpu.optim.downpour import Downpour
+from mpit_tpu.optim.shells import SingleWorker
+from mpit_tpu.ps import ParamClient, ParamServer, Shard, shard_layout
+
+
+class TestShardLayout:
+    def test_even_split(self):
+        assert shard_layout(12, 3) == [Shard(0, 4), Shard(4, 4), Shard(8, 4)]
+
+    def test_remainder_goes_to_last(self):
+        # floor(10/3)=3: [0,3) [3,6) [6,10) (reference pclient.lua:111-129)
+        assert shard_layout(10, 3) == [Shard(0, 3), Shard(3, 3), Shard(6, 4)]
+
+    def test_single_server_takes_all(self):
+        assert shard_layout(7, 1) == [Shard(0, 7)]
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            shard_layout(2, 3)
+        with pytest.raises(ValueError):
+            shard_layout(10, 0)
+
+
+@contextlib.contextmanager
+def launch(nservers, nclients, rule="add", single_mode=False):
+    """PS topology: servers on ranks [0, nservers) in threads, clients on
+    the following ranks, driven by the caller.  Teardown force-stops any
+    still-running server so a failed assertion can't leave busy-spinning
+    threads behind to starve later tests."""
+    n = nservers + nclients
+    router = LocalRouter(n)
+    sranks = list(range(nservers))
+    cranks = list(range(nservers, n))
+    servers = [
+        ParamServer(r, cranks, router.endpoint(r), rule=rule, single_mode=single_mode)
+        for r in sranks
+    ]
+    threads = [threading.Thread(target=s.start, daemon=True) for s in servers]
+    for t in threads:
+        t.start()
+    clients = [
+        ParamClient(r, sranks, router.endpoint(r), seed_servers=(r == cranks[0]))
+        for r in cranks
+    ]
+    try:
+        yield servers, clients, threads
+    finally:
+        for s in servers:
+            s.live.stop()
+        for t in threads:
+            t.join(5)
+
+
+def join_all(threads, timeout=30):
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "server did not stop (stop-protocol hang)"
+
+
+class TestPSBasic:
+    def test_seed_push_pull_single_shard(self, rng):
+        w0 = rng.normal(size=16).astype(np.float32)
+        with launch(1, 1) as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+
+            # Push a delta; server plain-adds; pull back.  Per-server op
+            # chaining guarantees the pull sees this client's own push.
+            grad[:] = 1.0
+            client.async_send_grad()
+            client.async_recv_param()
+            client.wait()
+            np.testing.assert_allclose(param, w0 + 1.0, rtol=1e-6)
+
+            client.stop()
+            join_all(threads)
+            assert servers[0].grads_applied == 1
+            assert servers[0].params_served == 1
+
+    def test_two_servers_shard_correctly(self, rng):
+        w0 = rng.normal(size=10).astype(np.float32)  # shards: [0,5) [5,10)
+        with launch(2, 1) as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+
+            delta = rng.normal(size=10).astype(np.float32)
+            grad[:] = delta
+            client.async_send_grad()
+            client.async_recv_param()
+            client.wait()
+            np.testing.assert_allclose(param, w0 + delta, rtol=1e-5)
+            # Each server holds exactly its contiguous slice.
+            np.testing.assert_allclose(
+                np.asarray(servers[0].param), (w0 + delta)[:5], rtol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(servers[1].param), (w0 + delta)[5:], rtol=1e-5)
+
+            client.stop()
+            join_all(threads)
+
+    def test_two_clients_share_center(self, rng):
+        w0 = rng.normal(size=8).astype(np.float32)
+        with launch(1, 2) as (servers, (c1, c2), threads):
+            p1, g1 = w0.copy(), np.zeros_like(w0)
+            p2, g2 = np.zeros_like(w0), np.zeros_like(w0)
+            # Clients must start concurrently (each is its own process in
+            # the reference): the server's init phase waits on both, and
+            # the seeder's start() blocks on the seed ack.
+            t1 = threading.Thread(target=c1.start, args=(p1, g1), daemon=True)
+            t2 = threading.Thread(target=c2.start, args=(p2, g2), daemon=True)
+            t1.start()
+            t2.start()
+            t1.join(30)
+            t2.join(30)
+            assert not t1.is_alive() and not t2.is_alive(), "client start hung"
+
+            # c2 pulls: sees the seed from c1.
+            c2.async_recv_param()
+            c2.wait()
+            np.testing.assert_allclose(p2, w0, rtol=1e-6)
+
+            # Both push deltas (awaiting acks); then c1 pulls the sum.
+            g1[:] = 1.0
+            c1.async_send_grad()
+            c1.wait()
+            g2[:] = 2.0
+            c2.async_send_grad()
+            c2.wait()
+            c1.async_recv_param()
+            c1.wait()
+            np.testing.assert_allclose(p1, w0 + 3.0, rtol=1e-6)
+
+            c1.stop()
+            c2.stop()
+            join_all(threads)
+
+    def test_server_side_adam(self, rng):
+        """Clients ship raw grads; servers apply Adam — result must match a
+        local Adam rollout on the full vector."""
+        w0 = rng.normal(size=12).astype(np.float32)
+        grads = [rng.normal(size=12).astype(np.float32) for _ in range(3)]
+        hp = dict(lr=1e-2, beta1=0.9, beta2=0.999, epsilon=1e-8)
+        with launch(2, 1, rule=rules.make("adam", **hp)) as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+            for g in grads:
+                grad[:] = g
+                client.async_send_grad()
+                client.wait()
+            client.async_recv_param()
+            client.wait()
+            client.stop()
+            join_all(threads)
+
+        rule = rules.make("adam", **hp)
+        p = jnp.asarray(w0)
+        st = rule.init(p)
+        for g in grads:
+            p, st = rule.apply(p, jnp.asarray(g), st)
+        np.testing.assert_allclose(param, np.asarray(p), rtol=1e-5)
+
+    def test_reset_retargets_buffers(self, rng):
+        w0 = rng.normal(size=6).astype(np.float32)
+        with launch(1, 1) as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+
+            alt_param = np.zeros_like(w0)
+            alt_grad = np.full_like(w0, 0.5)
+            client.reset(alt_param, alt_grad)
+            client.async_send_grad()
+            client.async_recv_param()
+            client.wait()
+            np.testing.assert_allclose(alt_param, w0 + 0.5, rtol=1e-6)
+            np.testing.assert_allclose(param, w0, rtol=1e-6)  # original untouched
+
+            client.stop()
+            join_all(threads)
+
+    def test_reset_length_mismatch(self, rng):
+        w0 = rng.normal(size=6).astype(np.float32)
+        with launch(1, 1) as (servers, (client,), threads):
+            client.start(w0.copy(), np.zeros_like(w0))
+            with pytest.raises(ValueError):
+                client.reset(np.zeros(7, np.float32), np.zeros(7, np.float32))
+            client.stop()
+            join_all(threads)
+
+
+class TestPSWithOptimizers:
+    def test_downpour_su1_end_to_end(self, rng):
+        """Full stack: Downpour -> ParamClient -> LocalTransport ->
+        ParamServer(plain add) matches serial SGD."""
+        w0 = rng.normal(size=8).astype(np.float32)
+        lr, steps = 0.1, 5
+        with launch(2, 1) as (servers, (client,), threads):
+            def vgf(w, target):
+                return 0.5 * jnp.sum((w - target) ** 2), w - target
+
+            opt = Downpour(vgf, client, lr=lr, su=1)
+            w = opt.start(jnp.asarray(w0))
+            target = jnp.zeros(8)
+            for _ in range(steps):
+                w, _ = opt.step(w, target)
+            opt.stop()
+            join_all(threads)
+
+        ref = w0.astype(np.float64)
+        for _ in range(steps):
+            ref = ref - lr * ref
+        np.testing.assert_allclose(np.asarray(w), ref, rtol=1e-4)
+
+    def test_single_worker_mirror(self, rng):
+        """SingleWorker pushes whole params; single_mode server mirrors them."""
+        w0 = rng.normal(size=6).astype(np.float32)
+        with launch(1, 1, single_mode=True) as (servers, (client,), threads):
+            def vgf(w, target):
+                return 0.5 * jnp.sum((w - target) ** 2), w - target
+
+            opt = SingleWorker(vgf, client, rule="adagrad", lr=0.1)
+            w = opt.start(jnp.asarray(w0))
+            for _ in range(3):
+                w, _ = opt.step(w, jnp.zeros(6))
+            opt.stop()
+            join_all(threads)
+            np.testing.assert_allclose(
+                np.asarray(servers[0].param), np.asarray(w), rtol=1e-5)
