@@ -1,0 +1,42 @@
+"""Build the native transport shared library (the CMakeLists analog,
+reference CMakeLists.txt:25-29 — one translation unit, one artifact).
+
+Compiled lazily on first use and cached by source mtime; force with
+``python -m mpit_tpu.comm.native.build``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import threading
+
+HERE = pathlib.Path(__file__).resolve().parent
+SRC = HERE / "transport.cpp"
+LIB = HERE / "libmt_transport.so"
+
+_lock = threading.Lock()
+
+CXXFLAGS = ["-std=c++17", "-O2", "-fPIC", "-shared", "-pthread", "-Wall"]
+
+
+def ensure_built(force: bool = False) -> pathlib.Path:
+    with _lock:
+        if not force and LIB.exists() and LIB.stat().st_mtime >= SRC.stat().st_mtime:
+            return LIB
+        cmd = ["g++", *CXXFLAGS, str(SRC), "-o", str(LIB), "-lrt"]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native transport build failed:\n$ {' '.join(cmd)}\n{proc.stderr}"
+            )
+        return LIB
+
+
+def main() -> None:
+    path = ensure_built(force=True)
+    print(f"built {path}")
+
+
+if __name__ == "__main__":
+    main()

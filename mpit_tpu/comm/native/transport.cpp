@@ -1,0 +1,504 @@
+// mt_transport — native shared-memory message transport for mpit_tpu.
+//
+// The role the reference fills with its Lua<->MPI C binding (reference
+// mpiT.c, lua-mpi.h, mpifuncs.c): a nonblocking, (rank, tag)-addressed,
+// zero-copy-into-caller-buffers transport driven by poll-style Test calls,
+// here for same-host role processes (the `mpirun -np N` single-host shape
+// the reference is exercised in, reference README.md:28-31).  Cross-host
+// paths ride XLA collectives over ICI/DCN and are not this file's job.
+//
+// Design (deliberately not an MPI clone):
+//  * One POSIX shm ring buffer per rank (its inbox).  Senders append
+//    variable-size chunks under a process-shared mutex; only the owner
+//    drains.  Chunking bounds ring residency so messages larger than the
+//    ring (the reference ships 640 MB parameter vectors, ptest.lua:3)
+//    stream through a small ring without deadlock.
+//  * Message assembly, (rank, tag) matching, and handle state live in
+//    process-local memory — the ring is purely a mailbox, so a receiver
+//    polling one tag never head-of-line-blocks other tags.
+//  * Per-destination FIFO send queues give MPI-style non-overtaking order
+//    between any (src, dst) pair.
+//  * All progress happens inside mt_iprobe/mt_test calls from the caller's
+//    cooperative scheduler — single-threaded per process, like the
+//    reference's coroutine polling (reference init.lua:147-185).
+//
+// Exported C API (ctypes bindings are generated from specs/*.json by
+// gen_bindings.py, mirroring the reference's readspec.py codegen).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kReadyMagic = 0x4d50495454505531ull;  // "MPITTPU1"
+constexpr uint64_t kMaxChunk = 1ull << 22;               // 4 MB
+
+struct RingHeader {
+  std::atomic<uint64_t> ready;  // kReadyMagic once initialized
+  pthread_mutex_t mutex;        // process-shared
+  uint64_t capacity;            // data-area bytes
+  uint64_t head;                // absolute bytes written (mod capacity)
+  uint64_t tail;                // absolute bytes consumed
+};
+
+struct ChunkHeader {
+  int32_t src;
+  int32_t tag;
+  uint64_t msg_id;      // per-sender sequence, for reassembly
+  uint32_t chunk_idx;
+  uint32_t nchunks;
+  uint64_t chunk_bytes;
+  uint64_t total_bytes;
+};
+
+struct Ring {
+  RingHeader* hdr = nullptr;
+  uint8_t* data = nullptr;
+  size_t map_bytes = 0;
+};
+
+struct Message {
+  std::vector<uint8_t> bytes;
+};
+
+struct Partial {
+  uint64_t total = 0;
+  uint32_t seen = 0;
+  int32_t tag = 0;
+  std::vector<uint8_t> bytes;
+};
+
+struct SendOp {
+  int dst = -1;
+  int tag = 0;
+  const uint8_t* data = nullptr;
+  uint64_t len = 0;
+  uint64_t written = 0;  // payload bytes already placed in the ring
+  uint64_t msg_id = 0;
+  uint32_t nchunks = 0;
+  uint32_t next_chunk = 0;
+  bool done = false;
+  bool cancelled = false;
+  uint32_t stalls = 0;  // consecutive zero-progress pump attempts
+};
+
+// After this many consecutive zero-progress attempts on a full peer ring,
+// suspect a stale mapping (peer crashed and recreated its segment) and
+// remap.  Normal backpressure resets the counter on any progress.
+constexpr uint32_t kStallRemapThreshold = 4096;
+
+struct RecvOp {
+  int src = -1;
+  int tag = 0;
+  uint8_t* out = nullptr;
+  uint64_t cap = 0;
+  uint64_t size = 0;
+  bool done = false;
+  bool cancelled = false;
+  bool size_mismatch = false;
+};
+
+struct Ctx {
+  std::string ns;
+  int rank = -1;
+  int nranks = 0;
+  uint64_t ring_bytes = 0;
+  Ring own;
+  std::vector<Ring> peers;  // lazily opened inboxes of other ranks
+  std::map<std::pair<int, int>, std::deque<Message>> ready;      // (src,tag)
+  std::map<std::pair<int, uint64_t>, Partial> partial;           // (src,msg_id)
+  std::map<int64_t, SendOp> sends;
+  std::map<int64_t, RecvOp> recvs;
+  std::map<int, std::deque<int64_t>> send_q;  // per-destination FIFO
+  int64_t next_handle = 1;
+  uint64_t next_msg_id = 1;
+  std::string last_error;
+};
+
+std::string shm_name(const std::string& ns, int rank) {
+  return "/mt_" + ns + "_r" + std::to_string(rank);
+}
+
+bool map_ring(const std::string& name, uint64_t ring_bytes, bool create,
+              Ring* out, std::string* err) {
+  int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
+  int fd = shm_open(name.c_str(), flags, 0600);
+  if (fd < 0) {
+    if (err) *err = "shm_open " + name + ": " + std::strerror(errno);
+    return false;
+  }
+  size_t total = sizeof(RingHeader) + ring_bytes;
+  if (create && ftruncate(fd, (off_t)total) != 0) {
+    if (err) *err = "ftruncate " + name + ": " + std::strerror(errno);
+    close(fd);
+    return false;
+  }
+  if (!create) {
+    // The creator sizes the segment; wait for a nonzero size.
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(RingHeader)) {
+      close(fd);
+      if (err) *err = "peer segment not sized yet";
+      return false;
+    }
+    total = (size_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    if (err) *err = "mmap " + name + ": " + std::strerror(errno);
+    return false;
+  }
+  out->hdr = reinterpret_cast<RingHeader*>(mem);
+  out->data = reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  out->map_bytes = total;
+  return true;
+}
+
+void circ_write(Ring& ring, uint64_t pos, const void* src, uint64_t n) {
+  uint64_t cap = ring.hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (off + n <= cap) ? n : cap - off;
+  std::memcpy(ring.data + off, src, first);
+  if (first < n) {
+    std::memcpy(ring.data, reinterpret_cast<const uint8_t*>(src) + first,
+                n - first);
+  }
+}
+
+void circ_read(Ring& ring, uint64_t pos, void* dst, uint64_t n) {
+  uint64_t cap = ring.hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (off + n <= cap) ? n : cap - off;
+  std::memcpy(dst, ring.data + off, first);
+  if (first < n) {
+    std::memcpy(reinterpret_cast<uint8_t*>(dst) + first, ring.data, n - first);
+  }
+}
+
+Ring* peer_ring(Ctx* ctx, int dst) {
+  if (dst < 0 || dst >= ctx->nranks) return nullptr;
+  Ring& ring = ctx->peers[dst];
+  if (ring.hdr == nullptr) {
+    std::string err;
+    if (!map_ring(shm_name(ctx->ns, dst), ctx->ring_bytes, /*create=*/false,
+                  &ring, &err)) {
+      return nullptr;  // peer not up yet; caller retries on next progress
+    }
+  }
+  if (ring.hdr->ready.load(std::memory_order_acquire) != kReadyMagic) {
+    return nullptr;
+  }
+  return &ring;
+}
+
+void unmap_peer(Ctx* ctx, int dst) {
+  Ring& ring = ctx->peers[dst];
+  if (ring.hdr != nullptr) {
+    munmap(ring.hdr, ring.map_bytes);
+    ring = Ring{};
+  }
+}
+
+// Robust lock: if the previous holder died mid-critical-section, take
+// ownership, mark the mutex consistent, and reset the ring indices (the
+// in-flight bytes are garbage after a crash; post-crash message loss is the
+// accepted semantic — the PS protocol's acks surface it to the caller).
+void lock_ring(RingHeader* hdr) {
+  int rc = pthread_mutex_lock(&hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    hdr->head = 0;
+    hdr->tail = 0;
+    pthread_mutex_consistent(&hdr->mutex);
+  }
+}
+
+// Drain the own inbox: move complete chunks into partial/ready maps.
+void drain_inbox(Ctx* ctx) {
+  Ring& ring = ctx->own;
+  lock_ring(ring.hdr);
+  uint64_t head = ring.hdr->head;
+  uint64_t tail = ring.hdr->tail;
+  std::vector<std::pair<ChunkHeader, std::vector<uint8_t>>> chunks;
+  while (tail < head) {
+    ChunkHeader ch;
+    circ_read(ring, tail, &ch, sizeof(ch));
+    tail += sizeof(ch);
+    std::vector<uint8_t> payload(ch.chunk_bytes);
+    if (ch.chunk_bytes > 0) circ_read(ring, tail, payload.data(), ch.chunk_bytes);
+    tail += ch.chunk_bytes;
+    chunks.emplace_back(ch, std::move(payload));
+  }
+  ring.hdr->tail = tail;
+  pthread_mutex_unlock(&ring.hdr->mutex);
+
+  for (auto& [ch, payload] : chunks) {
+    if (ch.chunk_bytes == ch.total_bytes) {  // complete in one chunk
+      ctx->ready[{ch.src, ch.tag}].push_back(Message{std::move(payload)});
+      continue;
+    }
+    auto key = std::make_pair(ch.src, ch.msg_id);
+    Partial& part = ctx->partial[key];
+    if (part.seen == 0) {
+      part.total = ch.total_bytes;
+      part.tag = ch.tag;
+      part.bytes.reserve(ch.total_bytes);
+    }
+    part.bytes.insert(part.bytes.end(), payload.begin(), payload.end());
+    part.seen++;
+    if (part.bytes.size() >= part.total) {  // byte-complete (chunk sizes vary)
+      ctx->ready[{ch.src, part.tag}].push_back(Message{std::move(part.bytes)});
+      ctx->partial.erase(key);
+    }
+  }
+}
+
+// Try to place more chunks of the front send op for each destination.
+void pump_sends(Ctx* ctx) {
+  for (auto& [dst, queue] : ctx->send_q) {
+    while (!queue.empty()) {
+      int64_t handle = queue.front();
+      auto it = ctx->sends.find(handle);
+      if (it == ctx->sends.end() || it->second.cancelled || it->second.done) {
+        queue.pop_front();
+        continue;
+      }
+      SendOp& op = it->second;
+      Ring* ring = peer_ring(ctx, dst);
+      if (ring == nullptr) break;  // destination not up yet
+      // A chunk must fit in the destination ring with its header; cap at
+      // half the ring so two senders can interleave without livelock.
+      uint64_t ring_cap = ring->hdr->capacity;
+      uint64_t fit_max = ring_cap > 2 * sizeof(ChunkHeader)
+                             ? (ring_cap - 2 * sizeof(ChunkHeader)) / 2
+                             : 1;
+      uint64_t max_chunk = kMaxChunk < fit_max ? kMaxChunk : fit_max;
+      bool progressed = true;
+      while (!op.done && progressed) {
+        progressed = false;
+        uint64_t remaining = op.len - op.written;
+        uint64_t chunk = remaining < max_chunk ? remaining : max_chunk;
+        uint64_t need = sizeof(ChunkHeader) + chunk;
+        lock_ring(ring->hdr);
+        uint64_t used = ring->hdr->head - ring->hdr->tail;
+        uint64_t free_bytes = ring->hdr->capacity - used;
+        if (free_bytes >= need) {
+          ChunkHeader ch;
+          ch.src = ctx->rank;
+          ch.tag = op.tag;
+          ch.msg_id = op.msg_id;
+          ch.chunk_idx = op.next_chunk;
+          ch.nchunks = 0;  // informational; completion is byte-based
+          ch.chunk_bytes = chunk;
+          ch.total_bytes = op.len;
+          circ_write(*ring, ring->hdr->head, &ch, sizeof(ch));
+          if (chunk > 0) {
+            circ_write(*ring, ring->hdr->head + sizeof(ch), op.data + op.written,
+                       chunk);
+          }
+          ring->hdr->head += need;
+          op.written += chunk;
+          op.next_chunk++;
+          op.stalls = 0;
+          progressed = true;
+          if (op.written >= op.len) op.done = true;
+        }
+        pthread_mutex_unlock(&ring->hdr->mutex);
+      }
+      if (!op.done) {
+        // Zero progress with a full ring: count stalls; past the threshold
+        // assume a stale mapping (peer recreated its segment) and remap.
+        if (++op.stalls >= kStallRemapThreshold) {
+          op.stalls = 0;
+          unmap_peer(ctx, dst);
+        }
+        break;  // keep FIFO order, stop for this dst
+      }
+      queue.pop_front();
+    }
+  }
+}
+
+void progress(Ctx* ctx) {
+  drain_inbox(ctx);
+  pump_sends(ctx);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mt_init(const char* ns, int rank, int nranks, uint64_t ring_bytes) {
+  auto* ctx = new Ctx();
+  ctx->ns = ns;
+  ctx->rank = rank;
+  ctx->nranks = nranks;
+  ctx->ring_bytes = ring_bytes;
+  ctx->peers.resize(nranks);
+  std::string name = shm_name(ctx->ns, rank);
+  shm_unlink(name.c_str());  // clear any stale segment from a crashed run
+  std::string err;
+  if (!map_ring(name, ring_bytes, /*create=*/true, &ctx->own, &err)) {
+    std::fprintf(stderr, "mt_init: %s\n", err.c_str());
+    delete ctx;
+    return nullptr;
+  }
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&ctx->own.hdr->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+  ctx->own.hdr->capacity = ring_bytes;
+  ctx->own.hdr->head = 0;
+  ctx->own.hdr->tail = 0;
+  ctx->own.hdr->ready.store(kReadyMagic, std::memory_order_release);
+  return ctx;
+}
+
+void mt_finalize(void* vctx) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  if (ctx == nullptr) return;
+  if (ctx->own.hdr != nullptr) {
+    munmap(ctx->own.hdr, ctx->own.map_bytes);
+    shm_unlink(shm_name(ctx->ns, ctx->rank).c_str());
+  }
+  for (Ring& ring : ctx->peers) {
+    if (ring.hdr != nullptr) munmap(ring.hdr, ring.map_bytes);
+  }
+  delete ctx;
+}
+
+int mt_rank(void* vctx) { return static_cast<Ctx*>(vctx)->rank; }
+int mt_nranks(void* vctx) { return static_cast<Ctx*>(vctx)->nranks; }
+
+int64_t mt_isend(void* vctx, int dst, int tag, const void* data, uint64_t len) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  if (dst < 0 || dst >= ctx->nranks) return -1;
+  SendOp op;
+  op.dst = dst;
+  op.tag = tag;
+  op.data = static_cast<const uint8_t*>(data);
+  op.len = len;
+  op.msg_id = ctx->next_msg_id++;
+  int64_t handle = ctx->next_handle++;
+  ctx->sends[handle] = op;
+  ctx->send_q[dst].push_back(handle);
+  progress(ctx);
+  return handle;
+}
+
+int64_t mt_irecv(void* vctx, int src, int tag, void* out, uint64_t cap) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  if (src < 0 || src >= ctx->nranks) return -1;
+  RecvOp op;
+  op.src = src;
+  op.tag = tag;
+  op.out = static_cast<uint8_t*>(out);
+  op.cap = cap;
+  int64_t handle = ctx->next_handle++;
+  ctx->recvs[handle] = op;
+  return handle;
+}
+
+int mt_iprobe(void* vctx, int src, int tag) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  progress(ctx);
+  auto it = ctx->ready.find({src, tag});
+  return (it != ctx->ready.end() && !it->second.empty()) ? 1 : 0;
+}
+
+int64_t mt_probe_size(void* vctx, int src, int tag) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  progress(ctx);
+  auto it = ctx->ready.find({src, tag});
+  if (it == ctx->ready.end() || it->second.empty()) return -1;
+  return (int64_t)it->second.front().bytes.size();
+}
+
+// Returns 1 complete, 0 pending, -1 unknown handle, -2 size mismatch.
+int mt_test(void* vctx, int64_t handle) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  progress(ctx);
+  auto sit = ctx->sends.find(handle);
+  if (sit != ctx->sends.end()) {
+    if (sit->second.cancelled) return -1;
+    if (sit->second.done) {
+      ctx->sends.erase(sit);
+      return 1;
+    }
+    return 0;
+  }
+  auto rit = ctx->recvs.find(handle);
+  if (rit != ctx->recvs.end()) {
+    RecvOp& op = rit->second;
+    if (op.cancelled) return -1;
+    if (op.done) return 1;
+    auto box = ctx->ready.find({op.src, op.tag});
+    if (box == ctx->ready.end() || box->second.empty()) return 0;
+    Message& msg = box->second.front();
+    if (msg.bytes.size() != op.cap) {
+      op.size_mismatch = true;
+      op.size = msg.bytes.size();
+      return -2;
+    }
+    if (op.cap > 0) std::memcpy(op.out, msg.bytes.data(), op.cap);
+    op.size = msg.bytes.size();
+    op.done = true;
+    box->second.pop_front();
+    return 1;
+  }
+  return -1;
+}
+
+int64_t mt_recv_size(void* vctx, int64_t handle) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  auto rit = ctx->recvs.find(handle);
+  if (rit == ctx->recvs.end()) return -1;
+  return (int64_t)rit->second.size;
+}
+
+void mt_cancel(void* vctx, int64_t handle) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  auto sit = ctx->sends.find(handle);
+  if (sit != ctx->sends.end()) {
+    // Chunks already in the peer ring stay (the receiver discards partial
+    // messages at finalize); the op stops producing more.
+    sit->second.cancelled = true;
+    ctx->sends.erase(sit);
+    return;
+  }
+  auto rit = ctx->recvs.find(handle);
+  if (rit != ctx->recvs.end()) ctx->recvs.erase(rit);
+}
+
+void mt_release(void* vctx, int64_t handle) {
+  auto* ctx = static_cast<Ctx*>(vctx);
+  ctx->recvs.erase(handle);
+  ctx->sends.erase(handle);
+}
+
+// Monotonic wall clock in seconds (the MPI_Wtime analog,
+// reference mpifuncs.c:2500-2513).
+double mt_time(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+}  // extern "C"
