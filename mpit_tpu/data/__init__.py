@@ -1,0 +1,5 @@
+"""Dataset loaders with offline-safe fallbacks."""
+
+from mpit_tpu.data.mnist import load_mnist
+
+__all__ = ["load_mnist"]

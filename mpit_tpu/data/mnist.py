@@ -1,0 +1,146 @@
+"""MNIST-shaped data: real MNIST when present, graceful offline fallbacks.
+
+The reference downloads pre-serialized 32x32 torch tensors and flattens
+them /255 (reference goot.lua:38-57).  This loader produces the same shape
+contract — float32 ``(n, side*side)`` in [0,1] plus int labels — from the
+best available source:
+
+1. real MNIST on disk (``mnist.npz`` keras layout or idx-ubyte files) under
+   ``$MPIT_DATA``, ``./data`` or ``~/.mpit/data``;
+2. scikit-learn's bundled digits (1797 8x8 images) upsampled to ``side``;
+3. a deterministic synthetic class-blob set (last resort, still trainable).
+
+The returned metadata names the source so benchmarks are honest about what
+they measured.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pathlib
+import struct
+from typing import Dict, Tuple
+
+import numpy as np
+
+Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+def _search_dirs():
+    env = os.environ.get("MPIT_DATA")
+    if env:
+        yield pathlib.Path(env)
+    yield pathlib.Path("data")
+    yield pathlib.Path.home() / ".mpit" / "data"
+
+
+def _load_idx(path: pathlib.Path) -> np.ndarray:
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as fh:
+        magic, = struct.unpack(">I", fh.read(4))
+        ndim = magic & 0xFF
+        shape = struct.unpack(f">{ndim}I", fh.read(4 * ndim))
+        return np.frombuffer(fh.read(), dtype=np.uint8).reshape(shape)
+
+
+def _try_real_mnist() -> Dict | None:
+    for base in _search_dirs():
+        npz = base / "mnist.npz"
+        if npz.exists():
+            with np.load(npz) as z:
+                return {
+                    "x_train": z["x_train"], "y_train": z["y_train"],
+                    "x_test": z["x_test"], "y_test": z["y_test"],
+                    "source": f"mnist.npz ({npz})",
+                }
+        for suffix in ("", ".gz"):
+            files = [base / (name + suffix) for name in (
+                "train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")]
+            if all(f.exists() for f in files):
+                return {
+                    "x_train": _load_idx(files[0]), "y_train": _load_idx(files[1]),
+                    "x_test": _load_idx(files[2]), "y_test": _load_idx(files[3]),
+                    "source": f"idx-ubyte ({base})",
+                }
+    return None
+
+
+def _digits_fallback(side: int):
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    images = d.images.astype(np.float32) / 16.0  # (1797, 8, 8) in [0,1]
+    factor = max(side // 8, 1)
+    up = np.kron(images, np.ones((1, factor, factor), np.float32))
+    if up.shape[1] < side:  # side not a multiple of 8: pad with zeros
+        pad = side - up.shape[1]
+        up = np.pad(up, ((0, 0), (0, pad), (0, pad)))
+    elif up.shape[1] > side:  # side < 8: center-crop
+        lo = (up.shape[1] - side) // 2
+        up = up[:, lo : lo + side, lo : lo + side]
+    n = len(up)
+    split = int(n * 0.85)
+    rng = np.random.default_rng(0)
+    order = rng.permutation(n)
+    train, test = order[:split], order[split:]
+    return {
+        "x_train": up[train], "y_train": d.target[train],
+        "x_test": up[test], "y_test": d.target[test],
+        "source": "sklearn-digits upsampled",
+    }
+
+
+def _synthetic(side: int, n_train: int = 8192, n_test: int = 2048):
+    rng = np.random.default_rng(42)
+    protos = rng.normal(size=(10, side * side)).astype(np.float32)
+
+    def make(n):
+        labels = rng.integers(0, 10, n)
+        x = protos[labels] * 0.5 + rng.normal(size=(n, side * side)).astype(np.float32) * 0.35
+        x = (x - x.min()) / (x.max() - x.min())
+        return x.reshape(n, side, side), labels
+
+    x_train, y_train = make(n_train)
+    x_test, y_test = make(n_test)
+    return {
+        "x_train": x_train, "y_train": y_train,
+        "x_test": x_test, "y_test": y_test,
+        "source": "synthetic-blobs",
+    }
+
+
+def load_mnist(side: int = 32, flatten: bool = True) -> Tuple[Arrays, str]:
+    """Returns ((x_train, y_train, x_test, y_test), source)."""
+    raw = _try_real_mnist()
+    if raw is not None:
+        # Resize 28x28 -> side via zero-padding (the reference ships 32x32
+        # tensors; padding preserves pixel values, goot.lua feeds them flat).
+        def prep(x):
+            x = x.astype(np.float32) / 255.0
+            if x.shape[1] < side:  # pad up (28 -> 32, the reference's shape)
+                pad = side - x.shape[1]
+                lo, hi = pad // 2, pad - pad // 2
+                x = np.pad(x, ((0, 0), (lo, hi), (lo, hi)))
+            elif x.shape[1] > side:  # center-crop down (e.g. side=8 tests)
+                lo = (x.shape[1] - side) // 2
+                x = x[:, lo : lo + side, lo : lo + side]
+            return x
+
+        x_train, x_test = prep(raw["x_train"]), prep(raw["x_test"])
+        y_train, y_test = raw["y_train"].astype(np.int32), raw["y_test"].astype(np.int32)
+        source = raw["source"]
+    else:
+        try:
+            raw = _digits_fallback(side)
+        except Exception:
+            raw = _synthetic(side)
+        x_train, x_test = raw["x_train"].astype(np.float32), raw["x_test"].astype(np.float32)
+        y_train, y_test = raw["y_train"].astype(np.int32), raw["y_test"].astype(np.int32)
+        source = raw["source"]
+
+    if flatten:
+        x_train = x_train.reshape(len(x_train), -1)
+        x_test = x_test.reshape(len(x_test), -1)
+    return (x_train, y_train, x_test, y_test), source

@@ -1,0 +1,14 @@
+"""Model zoo (Flax) + flat-parameter utilities.
+
+The reference's workloads are torch-nn graphs whose parameters are
+flattened into one vector via getParameters() (reference goot.lua:29-36,
+BiCNN/bicnn.lua:30-121).  Here models are Flax modules and the flat view is
+``jax.flatten_util.ravel_pytree`` — same contract (the PS layer shards a
+flat vector), TPU-native mechanics (the unravel closure restores the pytree
+inside jit for free).
+"""
+
+from mpit_tpu.models.mnist import MnistCNN, MnistLinear, MnistMLP
+from mpit_tpu.models.flat import FlatModel, flatten_module
+
+__all__ = ["MnistLinear", "MnistMLP", "MnistCNN", "FlatModel", "flatten_module"]

@@ -1,0 +1,36 @@
+"""Flat-parameter view over a Flax module (the getParameters() analog).
+
+The reference trains on a single flat tensor aliasing all model weights
+(reference goot.lua:33-36); the PS protocol shards that vector by offset
+(reference pclient.lua:111-129).  JAX arrays are immutable, so instead of
+aliasing we carry the ``unravel`` closure from ``ravel_pytree`` and
+re-materialize the pytree inside jit — XLA fuses the reshapes away, so the
+flat view costs nothing at runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+class FlatModel:
+    """A Flax module + flat-parameter calling convention."""
+
+    def __init__(self, module: Any, params: Any):
+        self.module = module
+        flat, unravel = ravel_pytree(params)
+        self.w0 = flat
+        self.unravel = unravel
+        self.size = int(flat.shape[0])
+
+    def apply_flat(self, w: jnp.ndarray, *args: Any, **kwargs: Any):
+        return self.module.apply({"params": self.unravel(w)}, *args, **kwargs)
+
+
+def flatten_module(module: Any, rng: jax.Array, sample_input: Any) -> FlatModel:
+    params = module.init(rng, sample_input)["params"]
+    return FlatModel(module, params)

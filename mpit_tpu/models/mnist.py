@@ -1,0 +1,63 @@
+"""MNIST model family.
+
+- :class:`MnistLinear` — the reference's claunch/mlaunch model: a single
+  Linear(1024 -> 10) + log-softmax trained with NLL (reference
+  goot.lua:29-35; dropout exists but is disabled by default,
+  goot.lua:31-32 / asyncsgd/dropout.lua).
+- :class:`MnistMLP` — one hidden layer, the natural first step up.
+- :class:`MnistCNN` — the BASELINE.json "MNIST CNN" config: a small
+  conv net shaped for the MXU (channel counts in multiples of 8,
+  bfloat16-friendly, NHWC).
+
+All take flattened ``(batch, H*W)`` float inputs (the reference flattens
+32x32 images the same way, goot.lua:43-57) and return log-probabilities.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistLinear(nn.Module):
+    num_classes: int = 10
+    dropout_rate: float = 0.0  # parity with reference dropout.lua, off by default
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        if self.dropout_rate > 0:
+            x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x)
+
+
+class MnistMLP(nn.Module):
+    hidden: int = 256
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x)
+
+
+class MnistCNN(nn.Module):
+    """Small MXU-friendly conv net over (batch, side*side) flat input."""
+
+    side: int = 32
+    num_classes: int = 10
+    width: int = 32  # base channel count; multiples map cleanly onto the MXU
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        batch = x.shape[0]
+        img = x.reshape(batch, self.side, self.side, 1)
+        img = nn.relu(nn.Conv(self.width, (3, 3), padding="SAME")(img))
+        img = nn.max_pool(img, (2, 2), strides=(2, 2))
+        img = nn.relu(nn.Conv(2 * self.width, (3, 3), padding="SAME")(img))
+        img = nn.max_pool(img, (2, 2), strides=(2, 2))
+        img = img.reshape(batch, -1)
+        img = nn.relu(nn.Dense(4 * self.width)(img))
+        img = nn.Dense(self.num_classes)(img)
+        return nn.log_softmax(img)

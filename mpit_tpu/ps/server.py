@@ -50,7 +50,9 @@ class ParamServer:
         self.transport = transport
         self.rule = make_rule(rule) if isinstance(rule, str) else rule
         self.sched = scheduler or Scheduler()
-        self.dtype = np.dtype(dtype)
+        from mpit_tpu.utils.serialize import resolve_dtype
+
+        self.dtype = resolve_dtype(dtype)
         self.single_mode = single_mode  # perpetual param-push service
         self.live = LiveFlag()
         self.log = get_logger("pserver", rank)

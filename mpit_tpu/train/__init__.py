@@ -1,0 +1,12 @@
+"""L4/L5 — trainers and launchers."""
+
+from mpit_tpu.train.trainer import MnistTrainer, TRAINER_DEFAULTS
+from mpit_tpu.train.launch import assign_roles, run_rank, server_rule_for
+
+__all__ = [
+    "MnistTrainer",
+    "TRAINER_DEFAULTS",
+    "assign_roles",
+    "run_rank",
+    "server_rule_for",
+]

@@ -1,0 +1,232 @@
+"""Launchers — the claunch/glaunch/mlaunch analogs.
+
+Role assignment follows the reference's conventions: with the default
+``master_freq=2``, even ranks become parameter servers and odd ranks become
+workers (reference mlaunch.lua:25-31); BiCNN generalizes to every
+``masterFreq``-th rank a server plus optional dedicated tester ranks
+(reference plaunch.lua:123-163) — the same rule implemented here.
+
+Three entry modes:
+
+- ``--np 1``: single-process local training, no comm (claunch.lua analog —
+  proves L4 is decoupled from L2/L1, SURVEY.md section 3.2);
+- ``--np N``: this process forks N role processes wired over the native
+  shm transport — the built-in ``mpirun -np N`` analog;
+- library use: :func:`run_rank` with injected transports, so tests run
+  whole topologies in threads on the in-process router.
+
+Usage:
+    python -m mpit_tpu.train.launch --np 4 --opt downpour --lr 0.01
+    python -m mpit_tpu.train.launch --np 12 --opt eamsgd --su 100 \\
+        --mom 0.99 --mva 0.15 --epochs 10
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from mpit_tpu.optim import rules as rules_mod
+from mpit_tpu.ps import ParamClient, ParamServer
+from mpit_tpu.train.trainer import TRAINER_DEFAULTS, MnistTrainer
+from mpit_tpu.utils.config import Config
+from mpit_tpu.utils.logging import get_logger
+
+LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
+    np=1,
+    master_freq=2,  # every master_freq-th rank is a server (mlaunch parity)
+    tester="none",  # none | first | last  (plaunch testerfirst/testerlast)
+    tester_rounds=10,
+    tester_interval=1.0,
+    ckpt_dir="",
+    ring_mb=64,
+    namespace="",
+)
+
+
+def assign_roles(
+    size: int, master_freq: int = 2, tester: str = "none"
+) -> Tuple[List[int], List[int], Optional[int]]:
+    """Returns (server_ranks, client_ranks, tester_rank)."""
+    ranks = list(range(size))
+    tester_rank: Optional[int] = None
+    if tester == "first":
+        tester_rank = 0
+        ranks = ranks[1:]
+    elif tester == "last":
+        tester_rank = size - 1
+        ranks = ranks[:-1]
+    sranks = [r for r in ranks if r % master_freq == 0]
+    cranks = [r for r in ranks if r % master_freq != 0]
+    if not sranks or not cranks:
+        raise ValueError(
+            f"role split produced {len(sranks)} servers / {len(cranks)} "
+            f"clients from size={size}, master_freq={master_freq}"
+        )
+    return sranks, cranks, tester_rank
+
+
+def server_rule_for(cfg: Config) -> Any:
+    """The server-side shard rule matching the client optimizer
+    (reference BiCNN/pserver.lua:123-197 dispatch)."""
+    name = cfg.opt
+    if name in ("rmsprop", "adam", "adamax", "adagrad", "adadelta"):
+        return rules_mod.make(name, lr=cfg.lr)
+    return rules_mod.make("add")  # downpour/easgd/eamsgd ship pre-scaled deltas
+
+
+def run_rank(
+    rank: int,
+    size: int,
+    cfg: Config,
+    transport: Any,
+    data: Any = None,
+) -> Dict[str, Any]:
+    """Run one rank's role to completion; returns its result dict."""
+    log = get_logger("launch", rank)
+    if size == 1:
+        trainer = MnistTrainer(cfg, pclient=None, data=data, rank=rank)
+        return {"role": "local", **trainer.run()}
+
+    sranks, cranks, tester_rank = assign_roles(
+        size, cfg.get("master_freq", 2), cfg.get("tester", "none")
+    )
+    single_mode = str(cfg.opt).endswith("-single")
+    if rank == tester_rank:
+        from mpit_tpu.train.tester import run_tester
+
+        return {"role": "tester", **run_tester(rank, sranks, cfg, transport, data)}
+    if rank in sranks:
+        # The tester counts as a (pull-only) client: it announces shards and
+        # participates in the stop protocol like any worker.
+        all_clients = cranks + ([tester_rank] if tester_rank is not None else [])
+        server = ParamServer(
+            rank, all_clients, transport, rule=server_rule_for(cfg),
+            single_mode=single_mode, dtype=cfg.get("dtype", "float32"),
+        )
+        log.info("server for clients %s", cranks)
+        server.start()
+        return {
+            "role": "server",
+            "grads_applied": server.grads_applied,
+            "params_served": server.params_served,
+        }
+    pclient = ParamClient(
+        rank, sranks, transport, seed_servers=(rank == cranks[0])
+    )
+    trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
+    log.info("worker with servers %s", sranks)
+    return {"role": "worker", **trainer.run()}
+
+
+# -- process-mode launcher (the mpirun analog) -------------------------------
+
+
+def _child_main() -> None:
+    rank = int(os.environ["MPIT_RANK"])
+    size = int(os.environ["MPIT_SIZE"])
+    cfg = Config(**json.loads(os.environ["MPIT_CFG"]))
+    from mpit_tpu.comm.shm import ShmTransport
+
+    transport = ShmTransport(
+        cfg.namespace, rank, size, ring_bytes=int(cfg.ring_mb) << 20
+    )
+    result = run_rank(rank, size, cfg, transport)
+    transport.close()
+    print(f"MPIT_RESULT {rank} {json.dumps(result)}", flush=True)
+
+
+def launch_processes(cfg: Config, timeout: float = 3600.0) -> Dict[int, Dict[str, Any]]:
+    size = int(cfg.np)
+    # Fail fast in the parent: a bad optimizer name discovered only inside a
+    # worker child would strand the server children in their stop protocol.
+    if cfg.opt not in MnistTrainer.KNOWN_OPTS:
+        raise ValueError(
+            f"unknown optimizer {cfg.opt!r}; have {MnistTrainer.KNOWN_OPTS}"
+        )
+    namespace = cfg.namespace or f"mpit{os.getpid()}"
+    cfg = cfg.merged(namespace=namespace)
+    env_base = {**os.environ, "MPIT_SIZE": str(size), "MPIT_CFG": json.dumps(cfg.to_dict())}
+    procs = []
+    for rank in range(size):
+        env = {**env_base, "MPIT_RANK": str(rank)}
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "mpit_tpu.train.launch", "--child"],
+                env=env,
+                stdout=subprocess.PIPE,
+                text=True,
+            )
+        )
+    # Monitor the gang: one dead rank starves its peers (servers wait for
+    # STOPs that will never arrive), so a failure tears the whole gang down.
+    deadline = time.monotonic() + timeout
+    failed: Optional[int] = None
+    while True:
+        states = [p.poll() for p in procs]
+        if all(s is not None for s in states):
+            break
+        bad = next((i for i, s in enumerate(states) if s not in (None, 0)), None)
+        if bad is not None or time.monotonic() > deadline:
+            failed = bad
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            break
+        time.sleep(0.2)
+    results: Dict[int, Dict[str, Any]] = {}
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        for line in (out or "").splitlines():
+            if line.startswith("MPIT_RESULT "):
+                _, r, payload = line.split(" ", 2)
+                results[int(r)] = json.loads(payload)
+            else:
+                print(line)
+    if failed is not None:
+        raise RuntimeError(
+            f"rank {failed} exited with {procs[failed].returncode}; gang torn down"
+        )
+    for rank, proc in enumerate(procs):
+        if proc.returncode != 0:
+            raise RuntimeError(f"rank {rank} exited with {proc.returncode}")
+    return results
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--child" in argv:
+        _child_main()
+        return
+    cfg = LAUNCH_DEFAULTS.parse_args(argv)
+    t0 = time.monotonic()
+    if int(cfg.np) == 1:
+        result = run_rank(0, 1, cfg, transport=None)
+        print(json.dumps({"rank0": _summarize(result)}, indent=2))
+    else:
+        results = launch_processes(cfg)
+        print(
+            json.dumps(
+                {str(r): _summarize(res) for r, res in sorted(results.items())},
+                indent=2,
+            )
+        )
+    print(f"total wall time: {time.monotonic() - t0:.1f}s")
+
+
+def _summarize(result: Dict[str, Any]) -> Dict[str, Any]:
+    keep = {"role", "final_test_err", "time_to_target", "elapsed",
+            "grads_applied", "params_served", "best_test_err"}
+    return {k: v for k, v in result.items() if k in keep}
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Dedicated tester role — pull params, evaluate, checkpoint the best.
+
+The reference's BiCNN tester rank loops forever: pull current params from
+the servers, evaluate the datasets, save a checkpoint, sleep (reference
+bicnn.lua:580-596; its never-stopping is a flagged TODO at :581).  This
+rebuild gives the tester a bounded lifecycle: ``tester_rounds`` pulls at
+``tester_interval`` seconds apart, then a clean stop — the server counts
+the tester among its clients, so the stop protocol stays exact.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+from mpit_tpu.ps import ParamClient
+from mpit_tpu.train.trainer import MnistTrainer
+from mpit_tpu.utils.checkpoint import save_flat
+from mpit_tpu.utils.config import Config
+from mpit_tpu.utils.logging import get_logger
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def run_tester(
+    rank: int,
+    server_ranks: list[int],
+    cfg: Config,
+    transport: Any,
+    data: Any = None,
+) -> Dict[str, Any]:
+    log = get_logger("tester", rank)
+    trainer = MnistTrainer(cfg, pclient=None, data=data, rank=rank)
+    plong = trainer.flat.size
+    from mpit_tpu.utils.serialize import resolve_dtype
+
+    dtype = resolve_dtype(cfg.get("dtype", "float32"))
+    param = np.zeros(plong, dtype)
+    grad = np.zeros_like(param)
+    pclient = ParamClient(rank, server_ranks, transport, seed_servers=False)
+    pclient.start(param, grad)
+
+    rounds = int(cfg.get("tester_rounds", 10))
+    interval = float(cfg.get("tester_interval", 1.0))
+    ckpt_dir = cfg.get("ckpt_dir")
+    best_err = float("inf")
+    history = []
+    for round_idx in range(rounds):
+        pclient.async_recv_param()
+        pclient.wait()
+        test_err = trainer.test_error(jnp.asarray(param))
+        history.append({"round": round_idx, "test_err": test_err})
+        if test_err < best_err:
+            best_err = test_err
+            if ckpt_dir:
+                save_flat(ckpt_dir, param, {"test_err": test_err, "round": round_idx})
+        log.info("round %d test_err %.4f (best %.4f)", round_idx, test_err, best_err)
+        if round_idx != rounds - 1:
+            time.sleep(interval)
+    pclient.stop()
+    return {"history": history, "best_test_err": best_err}

@@ -1,0 +1,64 @@
+"""Checkpoint/resume — the tester-rank save/load analog.
+
+The reference checkpoints whole-param tensors from the tester rank with a
+runtime-stamped filename and resumes via ``-loadmodel`` + ``-prevtime``
+(reference bicnn.lua:590-594, plaunch.lua:61-63); optimizer/server state is
+not checkpointed there.  Here checkpoints carry the flat param vector plus
+a metadata dict (step, metric, cumulative runtime), with orbax available
+for full-pytree checkpoints when models outgrow the flat path.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def save_flat(
+    directory: str | pathlib.Path,
+    w: Any,
+    meta: Optional[Dict[str, Any]] = None,
+    prefix: str = "ckpt",
+) -> pathlib.Path:
+    """Save the flat param vector; filename stamped with cumulative runtime
+    (the reference's timestamped torch.save, bicnn.lua:590-594)."""
+    import shutil
+
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    meta = dict(meta or {})
+    meta.setdefault("runtime", time.time())
+    # Millisecond stamp: sub-second saves (fast tester intervals) must not
+    # overwrite each other.
+    stamp = time.time_ns() // 1_000_000
+    path = directory / f"{prefix}_{stamp}.npz"
+    np.savez(path, w=np.asarray(w), meta=json.dumps(meta))
+    shutil.copyfile(path, directory / f"{prefix}_latest.npz")
+    return path
+
+
+def load_flat(path: str | pathlib.Path) -> Tuple[np.ndarray, Dict[str, Any]]:
+    with np.load(path, allow_pickle=False) as z:
+        return z["w"], json.loads(str(z["meta"]))
+
+
+def save_pytree(directory: str | pathlib.Path, pytree: Any, step: int) -> None:
+    """Full-pytree checkpoint via orbax (params + optimizer state)."""
+    import orbax.checkpoint as ocp
+
+    path = pathlib.Path(directory).resolve() / f"step_{step}"
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(path, pytree)
+    checkpointer.wait_until_finished()
+
+
+def load_pytree(directory: str | pathlib.Path, step: int, like: Any) -> Any:
+    import orbax.checkpoint as ocp
+
+    path = pathlib.Path(directory).resolve() / f"step_{step}"
+    checkpointer = ocp.StandardCheckpointer()
+    return checkpointer.restore(path, like)

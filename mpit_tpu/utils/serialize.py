@@ -28,13 +28,18 @@ def _dtype_name(dtype: np.dtype) -> str:
     return dtype.name
 
 
-def _resolve_dtype(name: str) -> np.dtype:
+def resolve_dtype(name) -> np.dtype:
+    """np.dtype from a name, covering ml_dtypes extension types
+    (bfloat16, fp8) that plain numpy doesn't know."""
     try:
         return np.dtype(name)
     except TypeError:
         import ml_dtypes  # ships with jax
 
         return np.dtype(getattr(ml_dtypes, name))
+
+
+_resolve_dtype = resolve_dtype  # internal alias used by decode_array
 
 
 def encode_array(array: Any) -> bytes:

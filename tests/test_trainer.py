@@ -1,0 +1,149 @@
+"""Trainer + launcher tests: single-process (claunch analog) and threaded
+multi-role topologies (mlaunch analog) on the in-process router.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mpit_tpu.comm.local import LocalRouter
+from mpit_tpu.data.mnist import load_mnist
+from mpit_tpu.train.launch import LAUNCH_DEFAULTS, assign_roles, run_rank, server_rule_for
+from mpit_tpu.train.trainer import MnistTrainer, TRAINER_DEFAULTS
+from mpit_tpu.utils.config import Config
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    (x_train, y_train, x_test, y_test), source = load_mnist(side=8)
+    # keep it tiny for 1-CPU test speed
+    return (x_train[:512], y_train[:512], x_test[:256], y_test[:256])
+
+
+class TestAssignRoles:
+    def test_parity_split(self):
+        sranks, cranks, tester = assign_roles(12)
+        assert sranks == [0, 2, 4, 6, 8, 10]
+        assert cranks == [1, 3, 5, 7, 9, 11]
+        assert tester is None
+
+    def test_master_freq_3(self):
+        sranks, cranks, _ = assign_roles(6, master_freq=3)
+        assert sranks == [0, 3]
+        assert cranks == [1, 2, 4, 5]
+
+    def test_tester_last(self):
+        sranks, cranks, tester = assign_roles(5, tester="last")
+        assert tester == 4
+        assert 4 not in sranks and 4 not in cranks
+
+    def test_tester_first(self):
+        sranks, cranks, tester = assign_roles(5, tester="first")
+        assert tester == 0
+        assert 0 not in sranks and 0 not in cranks
+
+    def test_degenerate_raises(self):
+        with pytest.raises(ValueError):
+            assign_roles(1)
+
+
+class TestServerRule:
+    def test_stateful_rules_match_opt(self):
+        assert server_rule_for(Config(opt="adam", lr=0.1)).apply is not None
+
+    def test_delta_optimizers_use_add(self):
+        from mpit_tpu.optim.rules import add_apply
+
+        rule = server_rule_for(Config(opt="eamsgd", lr=0.1))
+        assert rule.apply is add_apply
+
+
+class TestLocalTrainer:
+    def test_msgd_learns(self, small_data):
+        cfg = TRAINER_DEFAULTS.merged(
+            model="linear", opt="msgd", lr=0.3, mom=0.9, epochs=3,
+            batch=64, side=8,
+        )
+        trainer = MnistTrainer(cfg, data=small_data)
+        err0 = trainer.test_error()
+        result = trainer.run()
+        assert result["final_test_err"] < err0
+        assert result["final_test_err"] < 0.5
+        assert len(result["history"]) == 3
+        assert "feval" in result["timers"]
+
+    def test_comm_optimizer_without_client_raises(self, small_data):
+        cfg = TRAINER_DEFAULTS.merged(opt="downpour", side=8, epochs=1)
+        trainer = MnistTrainer(cfg, data=small_data)  # eval-only use is fine
+        with pytest.raises(ValueError, match="parameter client"):
+            trainer.run()
+
+
+def run_topology(size, cfg, data, timeout=300):
+    """Run all ranks of a topology on threads over the in-process router."""
+    router = LocalRouter(size)
+    results = {}
+    errors = {}
+
+    def target(rank):
+        try:
+            results[rank] = run_rank(rank, size, cfg, router.endpoint(rank), data=data)
+        except BaseException as exc:  # noqa: BLE001
+            errors[rank] = exc
+
+    threads = [threading.Thread(target=target, args=(r,), daemon=True) for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    # A crashed rank starves its peers, so surface rank errors first.
+    if errors:
+        raise next(iter(errors.values()))
+    assert not any(t.is_alive() for t in threads), f"topology hung; done={list(results)}"
+    return results
+
+
+class TestTopologies:
+    def test_downpour_np4(self, small_data):
+        cfg = LAUNCH_DEFAULTS.merged(
+            np=4, opt="downpour", lr=0.2, su=1, epochs=1, batch=64, side=8,
+        )
+        results = run_topology(4, cfg, small_data)
+        roles = {r: res["role"] for r, res in results.items()}
+        assert roles == {0: "server", 1: "worker", 2: "server", 3: "worker"}
+        for rank in (0, 2):
+            assert results[rank]["grads_applied"] > 0
+        for rank in (1, 3):
+            assert results[rank]["final_test_err"] < 0.8
+
+    def test_eamsgd_np4(self, small_data):
+        cfg = LAUNCH_DEFAULTS.merged(
+            np=4, opt="eamsgd", lr=0.2, mom=0.9, mva=0.45, su=5,
+            epochs=1, batch=64, side=8,
+        )
+        results = run_topology(4, cfg, small_data)
+        workers = [res for res in results.values() if res["role"] == "worker"]
+        assert len(workers) == 2
+        assert all(w["final_test_err"] < 0.8 for w in workers)
+
+    def test_tester_role(self, small_data, tmp_path):
+        cfg = LAUNCH_DEFAULTS.merged(
+            np=3, opt="downpour", lr=0.2, su=1, epochs=1, batch=64, side=8,
+            tester="last", tester_rounds=3, tester_interval=0.05,
+            ckpt_dir=str(tmp_path),
+        )
+        results = run_topology(3, cfg, small_data)
+        tester = results[2]
+        assert tester["role"] == "tester"
+        assert tester["best_test_err"] <= 1.0
+        assert len(tester["history"]) == 3
+        assert list(tmp_path.glob("ckpt_*.npz")), "tester should checkpoint"
+
+    def test_adam_server_stateful_np2(self, small_data):
+        cfg = LAUNCH_DEFAULTS.merged(
+            np=2, opt="adam", lr=1e-3, su=1, epochs=1, batch=64, side=8,
+        )
+        results = run_topology(2, cfg, small_data)
+        assert results[0]["role"] == "server" and results[0]["grads_applied"] > 0
+        assert results[1]["role"] == "worker"
