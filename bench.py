@@ -1,0 +1,152 @@
+"""Headline benchmark: flagship MNIST EASGD training throughput.
+
+Measures samples/sec of the jitted elastic-averaging train step (the
+mlaunch.lua flagship path, reference asyncsgd/mlaunch.lua:39-47 /
+optim-eamsgd.lua) on the available accelerator, with parameters and the
+elastic center sharded over the device mesh.
+
+``vs_baseline`` compares against a live-measured reference-equivalent:
+the same CNN + Nesterov-SGD step in torch on host CPU — the reference
+ran its ranks on CPU torch (SURVEY.md §6; the repo publishes no numbers,
+BASELINE.md), so CPU-torch throughput of the identical workload is the
+honest stand-in.  >1.0 means this framework beats the reference-shaped
+run.
+
+Prints exactly one JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 128
+SIDE = 32
+WIDTH = 32
+WARMUP = 20
+ITERS = 500
+TORCH_ITERS = 10
+
+
+def _log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def bench_jax() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from mpit_tpu.data.mnist import load_mnist
+    from mpit_tpu.models import MnistCNN, flatten_module
+    from mpit_tpu.optim.msgd import MSGDConfig
+    from mpit_tpu.parallel import MeshEASGD, make_mesh
+
+    devs = jax.devices()
+    _log(f"jax devices: {devs}")
+    mesh = make_mesh(devs)
+    n_dp = mesh.shape["dp"]
+
+    (x_train, y_train, _, _), source = load_mnist(side=SIDE)
+    _log(f"data source: {source}")
+
+    module = MnistCNN(side=SIDE, num_classes=10, width=WIDTH)
+    x0 = jnp.asarray(x_train[:2], jnp.float32)
+    flat = flatten_module(module, jax.random.PRNGKey(0), x0)
+    _log(f"flat params: {flat.size}")
+
+    def vgf(w, xb, yb):
+        def loss_fn(w):
+            logp = flat.apply_flat(w, xb)
+            return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
+
+        return jax.value_and_grad(loss_fn)(w)
+
+    # mlaunch flagship config shape: mom=0.99, mva=beta/p, su=100ish; su=1
+    # here so the *measured* step includes the elastic exchange every step
+    # (worst case for us, most honest vs the async reference).
+    trainer = MeshEASGD(
+        mesh, vgf, MSGDConfig(lr=1e-2, mom=0.99), mva=0.9 / max(n_dp, 1), su=1
+    )
+    state = trainer.init(flat.w0)
+
+    n = len(x_train)
+    per_worker = BATCH
+    need = n_dp * per_worker
+    idx = np.arange(need) % n
+    xs = jnp.asarray(x_train[idx].reshape(n_dp, per_worker, -1), jnp.float32)
+    ys = jnp.asarray(y_train[idx].reshape(n_dp, per_worker), jnp.int32)
+    batches = trainer.shard_batch(xs, ys)
+
+    for _ in range(WARMUP):
+        state, loss = trainer.step(state, *batches)
+    import jax as _j
+
+    _j.block_until_ready(state["w"])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, loss = trainer.step(state, *batches)
+    _j.block_until_ready(state["w"])
+    dt = time.perf_counter() - t0
+    sps = ITERS * n_dp * per_worker / dt
+    _log(f"jax: {ITERS} steps x {n_dp} workers x {per_worker} in {dt:.3f}s "
+         f"-> {sps:.1f} samples/s (loss {float(loss.mean()):.4f})")
+    return sps
+
+
+def bench_torch_cpu() -> float:
+    """Reference-equivalent: identical CNN + Nesterov SGD, torch on CPU."""
+    import torch
+    import torch.nn as tnn
+
+    torch.manual_seed(0)
+    torch.set_num_threads(max(torch.get_num_threads(), 1))
+    model = tnn.Sequential(
+        tnn.Conv2d(1, WIDTH, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
+        tnn.Conv2d(WIDTH, 2 * WIDTH, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
+        tnn.Flatten(),
+        tnn.Linear((SIDE // 4) ** 2 * 2 * WIDTH, 4 * WIDTH), tnn.ReLU(),
+        tnn.Linear(4 * WIDTH, 10), tnn.LogSoftmax(dim=1),
+    )
+    opt = torch.optim.SGD(model.parameters(), lr=1e-2, momentum=0.99, nesterov=True)
+    lossf = tnn.NLLLoss()
+    x = torch.randn(BATCH, 1, SIDE, SIDE)
+    y = torch.randint(0, 10, (BATCH,))
+
+    def step():
+        opt.zero_grad()
+        loss = lossf(model(x), y)
+        loss.backward()
+        opt.step()
+
+    for _ in range(3):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(TORCH_ITERS):
+        step()
+    dt = time.perf_counter() - t0
+    sps = TORCH_ITERS * BATCH / dt
+    _log(f"torch-cpu: {TORCH_ITERS} steps of {BATCH} in {dt:.3f}s -> {sps:.1f} samples/s")
+    return sps
+
+
+def main():
+    sps = bench_jax()
+    try:
+        base = bench_torch_cpu()
+        vs = sps / base if base > 0 else 0.0
+    except Exception as e:  # torch missing/broken: report raw throughput
+        _log(f"torch baseline failed: {e!r}")
+        vs = 0.0
+    print(json.dumps({
+        "metric": "mnist_easgd_train_samples_per_sec",
+        "value": round(sps, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""ICI-resident parallelism: device meshes, collectives, sharded trainers.
+
+The host-mediated asynchronous path (true PS semantics over the native
+transport) lives in :mod:`mpit_tpu.ps` / :mod:`mpit_tpu.comm`; this
+package is the on-mesh expression of the same capabilities — sharded
+state, collective push/pull, elastic averaging — for when workers share
+one ICI domain and loose lockstep is acceptable (SURVEY.md §7 "measure
+both, keep both").
+"""
+
+from mpit_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    param_sharding,
+    replicated,
+    worker_sharding,
+)
+from mpit_tpu.parallel.collective import (  # noqa: F401
+    allreduce_mean,
+    ps_pull,
+    ps_push,
+    ps_pushpull,
+    ring_shift,
+)
+from mpit_tpu.parallel.easgd import MeshEASGD  # noqa: F401
+from mpit_tpu.parallel.sync_dp import SyncDataParallel  # noqa: F401

@@ -1,0 +1,149 @@
+"""Mesh-native (synchronous) EASGD/EAMSGD — elastic averaging as sharded
+XLA programs over a (dp, shard) device mesh.
+
+The reference realizes elastic averaging with *asynchronous* host-mediated
+push/pull against sharded parameter servers (reference
+asyncsgd/optim-eamsgd.lua, asyncsgd/pserver.lua).  That path exists here
+too (:mod:`mpit_tpu.optim.easgd` + :mod:`mpit_tpu.ps`).  This module is
+the ICI-resident expression of the same algorithm:
+
+- every worker's parameters live as one row of a ``(n_dp, plong)`` array,
+  rows sharded over ``dp`` and columns over ``shard`` — each device holds
+  exactly one worker-shard tile in HBM;
+- the center variable w* is a ``(plong,)`` array sharded over ``shard``
+  (the mesh form of the reference's per-server shard slices,
+  pclient.lua:111-129);
+- the local Nesterov update (identical math to
+  :mod:`mpit_tpu.optim.msgd`) is vmapped over the ``dp`` axis;
+- the elastic exchange — every su-th step — is
+  ``w* += mva * sum_i(w_i - w*)``, ``w_i -= mva * (w_i - w*)``
+  (the simultaneous application of every worker's push, reference
+  optim-eamsgd.lua:58-66 / pserver.lua:83), which XLA lowers to one
+  reduce + broadcast over the ``dp`` ICI ring.
+
+With ``mva = beta/p`` (the mlaunch config, reference mlaunch.lua:42) the
+center moves by ``beta * (mean_i(w_i) - w*)`` per sync — the synchronous
+EASGD of the paper.  All state stays in HBM across steps; nothing touches
+the host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpit_tpu.optim.msgd import MSGDConfig, msgd_commit, msgd_lookahead
+
+
+class MeshEASGD:
+    """Synchronous elastic-averaging trainer over a (dp, shard) mesh.
+
+    ``value_and_grad_fn(w, xb, yb) -> (loss, grad)`` operates on one
+    worker's flat parameter vector.  Batches are fed stacked per worker:
+    ``(n_dp, batch, ...)``.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        value_and_grad_fn: Callable[..., Tuple[jnp.ndarray, jnp.ndarray]],
+        cfg: MSGDConfig,
+        *,
+        mva: float,
+        su: int = 1,
+    ):
+        if not (su > 0 and mva > 0):
+            raise ValueError("easgd requires su>0 and mva>0 (reference :86)")
+        self.mesh = mesh
+        self.cfg = cfg
+        self.mva = float(mva)
+        self.su = int(su)
+        self.n_dp = mesh.shape["dp"]
+        self._steps = 0
+
+        ws = NamedSharding(mesh, P("dp", "shard"))   # per-worker param rows
+        ks = NamedSharding(mesh, P("dp"))            # per-worker counters
+        cs = NamedSharding(mesh, P("shard"))         # center shards
+        bs = NamedSharding(mesh, P("dp"))            # per-worker batches
+        rep = NamedSharding(mesh, P())
+        self._shardings = {"w": ws, "k": ks, "center": cs, "batch": bs}
+
+        def _one_local(w_i, vt_i, k_i, *args):
+            st = {"k": k_i, "vt": vt_i}
+            w_la, st = msgd_lookahead(w_i, st, cfg)
+            loss, grad = value_and_grad_fn(w_la, *args)
+            w_n, st = msgd_commit(w_la, grad, st, cfg)
+            return w_n, st["vt"], st["k"], loss
+
+        def _local(w, vt, k, *args):
+            return jax.vmap(_one_local)(w, vt, k, *args)
+
+        def _step_sync(w, vt, k, center, *args):
+            # Sync round: pull+push around the local update, same ordering
+            # as the reference (elastic delta uses pre-update w,
+            # optim-eamsgd.lua:54-61; retract after localupdate, :66).
+            sug = self.mva * (w - center[None, :])  # every worker's push
+            new_center = center + jnp.sum(sug, axis=0)
+            w, vt, k, loss = _local(w, vt, k, *args)
+            w = w - sug
+            return w, vt, k, new_center, loss
+
+        self._local_jit = jax.jit(
+            _local,
+            in_shardings=(ws, ws, ks) + (bs, bs),
+            out_shardings=(ws, ws, ks, ks),
+            donate_argnums=(0, 1, 2),
+        )
+        self._sync_jit = jax.jit(
+            _step_sync,
+            in_shardings=(ws, ws, ks, cs) + (bs, bs),
+            out_shardings=(ws, ws, ks, cs, ks),
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+    # -- state ---------------------------------------------------------------
+
+    def init(self, w0: jnp.ndarray) -> Dict[str, Any]:
+        """Replicate a single flat param vector into per-worker rows + the
+        center, placed with their mesh shardings (all workers and the
+        center start identical — the reference's init-once protocol,
+        pserver.lua:92-102)."""
+        w = jnp.broadcast_to(w0[None, :], (self.n_dp, w0.shape[0]))
+        state = {
+            "w": jax.device_put(w, self._shardings["w"]),
+            "vt": jax.device_put(jnp.zeros_like(w), self._shardings["w"]),
+            "k": jax.device_put(
+                jnp.zeros((self.n_dp,), jnp.int32), self._shardings["k"]
+            ),
+            "center": jax.device_put(jnp.asarray(w0), self._shardings["center"]),
+        }
+        self._steps = 0
+        return state
+
+    def shard_batch(self, *arrays: jnp.ndarray):
+        """Place (n_dp, batch, ...) stacked arrays with the dp sharding."""
+        return tuple(jax.device_put(a, self._shardings["batch"]) for a in arrays)
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self, state: Dict[str, Any], *batch: jnp.ndarray):
+        """One training step for every worker; elastic exchange on every
+        su-th call (first call included, as in the reference's
+        ``k % su == 0`` test, optim-eamsgd.lua:47)."""
+        if self._steps % self.su == 0:
+            w, vt, k, center, loss = self._sync_jit(
+                state["w"], state["vt"], state["k"], state["center"], *batch
+            )
+        else:
+            w, vt, k, loss = self._local_jit(
+                state["w"], state["vt"], state["k"], *batch
+            )
+            center = state["center"]
+        self._steps += 1
+        return {"w": w, "vt": vt, "k": k, "center": center}, loss
+
+    def center_params(self, state: Dict[str, Any]) -> jnp.ndarray:
+        return state["center"]

@@ -1,0 +1,87 @@
+"""Device-mesh construction and role helpers.
+
+The reference assigns roles by MPI rank parity (reference
+asyncsgd/mlaunch.lua:25-31 — even ranks are servers, odd are clients) and
+scales by adding ranks.  TPU-native scaling is a 2-D ``jax.sharding.Mesh``
+instead:
+
+- axis ``dp`` — data-parallel workers (the reference's *clients*);
+- axis ``shard`` — the 1-D parameter/optimizer-state shard axis (the
+  reference's *servers*: the flat param vector split by offset,
+  reference pclient.lua:111-129, maps onto ``PartitionSpec('shard')``).
+
+Collectives over these axes ride ICI.  Multi-host meshes come for free:
+``jax.devices()`` after ``jax.distributed.initialize()`` spans all hosts
+and the same axis names apply (XLA routes cross-host hops over DCN).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    dp: Optional[int] = None,
+    shard: Optional[int] = None,
+    axis_names: Tuple[str, str] = ("dp", "shard"),
+) -> Mesh:
+    """Build a 2-D (dp, shard) mesh over ``devices`` (default: all).
+
+    If only one of ``dp``/``shard`` is given the other is inferred; if
+    neither is given the device count is factored with ``dp`` taking the
+    larger factor (workers usually outnumber shard groups, as in the
+    reference's 6-worker/6-server mlaunch split).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if dp is None and shard is None:
+        shard = _largest_divisor_at_most(n, int(np.sqrt(n)))
+        dp = n // shard
+    elif dp is None:
+        if n % shard:
+            raise ValueError(f"{n} devices not divisible by shard={shard}")
+        dp = n // shard
+    elif shard is None:
+        if n % dp:
+            raise ValueError(f"{n} devices not divisible by dp={dp}")
+        shard = n // dp
+    if dp * shard != n:
+        raise ValueError(f"dp*shard = {dp}*{shard} != {n} devices")
+    arr = np.array(devs).reshape(dp, shard)
+    return Mesh(arr, axis_names)
+
+
+def _largest_divisor_at_most(n: int, cap: int) -> int:
+    for d in range(max(cap, 1), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def param_sharding(mesh: Mesh, axis: str = "shard") -> NamedSharding:
+    """1-D sharding of a flat parameter vector over the shard axis —
+    the mesh expression of the reference's offset-sliced server shards
+    (reference pclient.lua:111-129)."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def worker_sharding(mesh: Mesh, *, shard_params: bool = True) -> NamedSharding:
+    """Sharding for a (n_dp, plong) stack of per-worker flat params:
+    rows over ``dp``, columns optionally over ``shard``."""
+    spec = PartitionSpec("dp", "shard" if shard_params else None)
+    return NamedSharding(mesh, spec)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Per-worker batches: leading dp axis, unsharded feature axes."""
+    return NamedSharding(mesh, PartitionSpec("dp"))

@@ -36,14 +36,32 @@ def save_flat(
     # overwrite each other.
     stamp = time.time_ns() // 1_000_000
     path = directory / f"{prefix}_{stamp}.npz"
-    np.savez(path, w=np.asarray(w), meta=json.dumps(meta))
+    arr = np.asarray(w)
+    # Store raw bytes + dtype name, not the array: np.savez silently
+    # round-trips ml_dtypes arrays (bfloat16 & co) as anonymous void
+    # records, which load as unusable '|V2' data.
+    np.savez(
+        path,
+        w_raw=np.frombuffer(arr.tobytes(), np.uint8),
+        w_dtype=str(arr.dtype),
+        w_shape=np.asarray(arr.shape, np.int64),
+        meta=json.dumps(meta),
+    )
     shutil.copyfile(path, directory / f"{prefix}_latest.npz")
     return path
 
 
 def load_flat(path: str | pathlib.Path) -> Tuple[np.ndarray, Dict[str, Any]]:
+    from mpit_tpu.utils.serialize import resolve_dtype
+
     with np.load(path, allow_pickle=False) as z:
-        return z["w"], json.loads(str(z["meta"]))
+        if "w" in z:  # legacy layout (native-dtype arrays only)
+            return z["w"], json.loads(str(z["meta"]))
+        dtype = resolve_dtype(str(z["w_dtype"]))
+        # copy(): frombuffer over bytes is read-only; callers resume
+        # training into this array.
+        w = np.frombuffer(z["w_raw"].tobytes(), dtype).reshape(z["w_shape"]).copy()
+        return w, json.loads(str(z["meta"]))
 
 
 def save_pytree(directory: str | pathlib.Path, pytree: Any, step: int) -> None:

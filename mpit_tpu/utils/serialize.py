@@ -24,7 +24,7 @@ _OBJECT_MAGIC = b"MTO1"  # mpit-tpu object v1
 
 def _dtype_name(dtype: np.dtype) -> str:
     # np.dtype.str loses identity for extension types (bfloat16/fp8 from
-    # ml_dtypes map to '<V2'/'|V1'); the name round-trips via _resolve_dtype.
+    # ml_dtypes map to '<V2'/'|V1'); the name round-trips via resolve_dtype.
     return dtype.name
 
 
@@ -39,7 +39,7 @@ def resolve_dtype(name) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-_resolve_dtype = resolve_dtype  # internal alias used by decode_array
+
 
 
 def encode_array(array: Any) -> bytes:
@@ -59,7 +59,7 @@ def decode_array(blob: bytes | memoryview, out: np.ndarray | None = None) -> np.
     if magic != _ARRAY_MAGIC:
         raise ValueError(f"bad array magic {magic!r}")
     offset = 5
-    dtype = _resolve_dtype(bytes(view[offset : offset + dlen]).decode())
+    dtype = resolve_dtype(bytes(view[offset : offset + dlen]).decode())
     offset += dlen
     (ndim,) = struct.unpack_from("<B", view, offset)
     offset += 1

@@ -17,6 +17,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# The environment may pre-import jax at interpreter startup (e.g. a TPU
+# plugin registered from sitecustomize), in which case the env vars above
+# are read too late — force the platform through the live config instead.
+# Safe as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

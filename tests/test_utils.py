@@ -84,3 +84,31 @@ class TestSerialize:
 
         np.testing.assert_array_equal(decode(encode(arr)), arr)
         assert decode(encode({"a": 1})) == {"a": 1}
+
+
+class TestCheckpoint:
+    def test_flat_roundtrip(self, tmp_path):
+        from mpit_tpu.utils.checkpoint import load_flat, save_flat
+
+        w = np.linspace(-1, 1, 11, dtype=np.float32)
+        path = save_flat(tmp_path, w, {"step": 7})
+        w2, meta = load_flat(path)
+        np.testing.assert_array_equal(w2, w)
+        assert meta["step"] == 7
+        w3, _ = load_flat(tmp_path / "ckpt_latest.npz")
+        np.testing.assert_array_equal(w3, w)
+
+    def test_flat_roundtrip_bfloat16(self, tmp_path):
+        # np.savez alone would degrade ml_dtypes arrays to void records;
+        # the raw-bytes layout must preserve the extension dtype.
+        import ml_dtypes
+
+        from mpit_tpu.utils.checkpoint import load_flat, save_flat
+
+        w = np.arange(9, dtype=ml_dtypes.bfloat16).reshape(3, 3)
+        path = save_flat(tmp_path, w, {"step": 1})
+        w2, _ = load_flat(path)
+        assert w2.dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(
+            w2.astype(np.float32), w.astype(np.float32)
+        )
