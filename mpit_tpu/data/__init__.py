@@ -1,5 +1,6 @@
 """Dataset loaders with offline-safe fallbacks."""
 
 from mpit_tpu.data.mnist import load_mnist
+from mpit_tpu.data.qa import QAData, load_qa, synthetic_qa
 
-__all__ = ["load_mnist"]
+__all__ = ["load_mnist", "QAData", "load_qa", "synthetic_qa"]

@@ -1,0 +1,62 @@
+"""Custom layers — the reference's hand-written nn modules, TPU-native.
+
+The reference ships three custom torch-nn layers with hand-derived
+backward passes (SURVEY.md section 2, row 26):
+
+- ``nn.Normalize``-style Lp normalization with a full Jacobian backward
+  (reference BiCNN/Normalize.lua:40-76) — here :func:`lp_normalize`, one
+  jnp expression whose exact Jacobian comes from autodiff;
+- ``nn.DivideConstant`` computing ``c/x`` with the ``-c/x**2`` gradient
+  (reference BiCNN/DivideConstant.lua:13-25) — here
+  :func:`divide_constant`;
+- Bernoulli dropout (reference asyncsgd/dropout.lua) — covered by
+  ``flax.linen.Dropout`` in the model zoo (mnist.py), off by default to
+  match reference goot.lua:31-32.
+
+Additionally :func:`masked_max_pool` — the TPU-native replacement for the
+reference's per-example variable-length ``nn.Max(1)`` over conv frames
+(reference BiCNN/bicnn.lua:78-81): sequences are padded to a static
+length and invalid frames are masked to ``-inf`` before the max, so one
+XLA program serves every length.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def lp_normalize(x: jnp.ndarray, p: float = 2.0, eps: float = 1e-10, axis: int = -1) -> jnp.ndarray:
+    """x / ||x||_p along ``axis`` (reference BiCNN/Normalize.lua:20-38).
+
+    The reference hand-codes the Jacobian backward (Normalize.lua:40-76);
+    under JAX the exact derivative is produced by autodiff.  ``eps``
+    guards the zero-vector case the same way the reference's
+    ``norm + eps`` does (Normalize.lua:29).
+    """
+    if p == jnp.inf:
+        norm = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    else:
+        norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / (norm + eps)
+
+
+def divide_constant(x: jnp.ndarray, constant: float = 1.0) -> jnp.ndarray:
+    """``constant / x`` elementwise (reference BiCNN/DivideConstant.lua:13-17);
+    the ``-c/x**2`` gradient (DivideConstant.lua:19-25) falls out of autodiff."""
+    return constant / x
+
+
+def masked_max_pool(frames: jnp.ndarray, n_valid: jnp.ndarray) -> jnp.ndarray:
+    """Max over the time axis of ``frames`` (..., T, F), counting only the
+    first ``n_valid`` frames per example.
+
+    Replaces the reference's per-example ``nn.Max(1)`` on variably-sized
+    conv outputs (BiCNN/bicnn.lua:78-81) with a static-shape masked max —
+    the XLA-friendly form: pad, mask to -inf, reduce.
+    """
+    t = frames.shape[-2]
+    idx = jnp.arange(t)
+    mask = idx[None, :] < n_valid[..., None]  # (..., T)
+    neg = jnp.finfo(frames.dtype).min
+    masked = jnp.where(mask[..., None], frames, neg)
+    return jnp.max(masked, axis=-2)

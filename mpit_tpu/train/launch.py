@@ -24,10 +24,7 @@ Usage:
 from __future__ import annotations
 
 import json
-import os
-import subprocess
 import sys
-import tempfile
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -128,119 +125,25 @@ def run_rank(
 
 
 def _child_main() -> None:
-    rank = int(os.environ["MPIT_RANK"])
-    size = int(os.environ["MPIT_SIZE"])
-    cfg = Config(**json.loads(os.environ["MPIT_CFG"]))
-    from mpit_tpu.comm.shm import ShmTransport
+    from mpit_tpu.train.gang import child_env, child_transport, write_result
 
-    transport = ShmTransport(
-        cfg.namespace, rank, size, ring_bytes=int(cfg.ring_mb) << 20
-    )
+    rank, size, cfg = child_env()
+    transport = child_transport(cfg, rank, size)
     result = run_rank(rank, size, cfg, transport)
     transport.close()
-    # Results travel over a dedicated file, not stdout: log lines from
-    # library threads could interleave with (and corrupt) a stdout protocol.
-    result_file = os.environ.get("MPIT_RESULT_FILE")
-    if result_file:
-        with open(result_file, "w") as fh:
-            json.dump(result, fh)
-    else:
-        print(f"MPIT_RESULT {rank} {json.dumps(result)}", flush=True)
+    write_result(result)
 
 
 def launch_processes(cfg: Config, timeout: float = 3600.0) -> Dict[int, Dict[str, Any]]:
-    size = int(cfg.np)
     # Fail fast in the parent: a bad optimizer name discovered only inside a
     # worker child would strand the server children in their stop protocol.
     if cfg.opt not in MnistTrainer.KNOWN_OPTS:
         raise ValueError(
             f"unknown optimizer {cfg.opt!r}; have {MnistTrainer.KNOWN_OPTS}"
         )
-    namespace = cfg.namespace or f"mpit{os.getpid()}"
-    cfg = cfg.merged(namespace=namespace)
-    env_base = {**os.environ, "MPIT_SIZE": str(size), "MPIT_CFG": json.dumps(cfg.to_dict())}
-    # Children write to per-rank log files, not pipes: nobody needs to
-    # drain them while the gang runs, so a log-heavy child can never block
-    # on a full pipe buffer mid-run.
-    logdir = tempfile.mkdtemp(prefix=f"{namespace}_logs_")
-    procs = []
-    logfiles = []
-    resultfiles = []
-    for rank in range(size):
-        logpath = os.path.join(logdir, f"rank{rank}.log")
-        resultpath = os.path.join(logdir, f"rank{rank}.result.json")
-        logfiles.append(logpath)
-        resultfiles.append(resultpath)
-        env = {
-            **env_base,
-            "MPIT_RANK": str(rank),
-            "MPIT_RESULT_FILE": resultpath,
-        }
-        with open(logpath, "w") as fh:
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, "-m", "mpit_tpu.train.launch", "--child"],
-                    env=env,
-                    stdout=fh,
-                    stderr=subprocess.STDOUT,
-                    text=True,
-                )
-            )
-    # Monitor the gang: one dead rank starves its peers (servers wait for
-    # STOPs that will never arrive), so a failure tears the whole gang down.
-    deadline = time.monotonic() + timeout
-    failed: Optional[int] = None
-    timed_out = False
-    while True:
-        states = [p.poll() for p in procs]
-        if all(s is not None for s in states):
-            break
-        bad = next((i for i, s in enumerate(states) if s not in (None, 0)), None)
-        timed_out = time.monotonic() > deadline
-        if bad is not None or timed_out:
-            failed = bad
-            for p in procs:
-                if p.poll() is None:
-                    p.terminate()
-            break
-        time.sleep(0.2)
-    for proc in procs:
-        try:
-            proc.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
-    results: Dict[int, Dict[str, Any]] = {}
-    for rank, (logpath, resultpath) in enumerate(zip(logfiles, resultfiles)):
-        with open(logpath) as fh:
-            for line in fh:
-                print(line.rstrip("\n"))
-        if os.path.exists(resultpath):
-            with open(resultpath) as fh:
-                results[rank] = json.load(fh)
-    if timed_out and failed is None:
-        alive = [r for r, s in enumerate(states) if s is None]
-        raise RuntimeError(
-            f"gang timed out after {timeout:.0f}s; ranks still running at "
-            f"teardown: {alive}; gang torn down (logs: {logdir})"
-        )
-    if failed is not None:
-        raise RuntimeError(
-            f"rank {failed} exited with {procs[failed].returncode}; "
-            f"gang torn down (logs: {logdir})"
-        )
-    for rank, proc in enumerate(procs):
-        if proc.returncode != 0:
-            raise RuntimeError(f"rank {rank} exited with {proc.returncode}")
-    missing = [r for r in range(size) if r not in results]
-    if missing:
-        raise RuntimeError(
-            f"ranks {missing} exited 0 but reported no result (logs: {logdir})"
-        )
-    import shutil
+    from mpit_tpu.train.gang import launch_gang
 
-    shutil.rmtree(logdir, ignore_errors=True)  # only useful on failure
-    return results
+    return launch_gang("mpit_tpu.train.launch", cfg, timeout)
 
 
 def main(argv: Optional[List[str]] = None) -> None:

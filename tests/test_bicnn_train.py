@@ -1,0 +1,249 @@
+"""BiCNN trainer: feval semantics, learning, roles, distributed topologies."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.comm.local import LocalRouter
+from mpit_tpu.data import qa
+from mpit_tpu.train.bicnn import BICNN_DEFAULTS, BiCNNTrainer, server_rule_for
+from mpit_tpu.train.bicnn_launch import BICNN_LAUNCH_DEFAULTS, assign_roles, run_rank
+
+TINY = dict(
+    embedding_dim=6, word_hidden_dim=8, num_filters=10, cont_conv_width=2,
+    maxnegsample=4, batch_size=8, eval_chunk=16, loss_report_every=10**9,
+)
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("qa_train")
+    paths = qa.synthetic_qa(d, n_labels=10, n_train=96, n_eval=16,
+                            embedding_dim=6, vocab_words=60, seed=11)
+    return qa.load_qa_files(embedding_dim=6, conv_width=2, **paths)
+
+
+def make_trainer(data, pclient=None, rank=0, **over):
+    cfg = BICNN_DEFAULTS.merged(TINY).merged(over)
+    return BiCNNTrainer(cfg, pclient=pclient, data=data, rank=rank)
+
+
+class TestFeval:
+    def test_negative_sampling_rejects_gold(self, data):
+        tr = make_trainer(data, optimization="sgd")
+        labels = [data.train.labels[i] for i in range(8)]
+        for _ in range(5):
+            nt, nl = tr.sample_negatives(labels)
+            assert nt.shape[:2] == (8, 4)
+            rows_by_label = {lab: data.answer_tokens[data.label2row[lab]]
+                            for lab in {l for ls in labels for l in ls}}
+            for i, gold in enumerate(labels):
+                for k in range(nt.shape[1]):
+                    for lab in gold:
+                        assert not np.array_equal(nt[i, k], rows_by_label[lab])
+
+    def test_vgf_loss_and_grad_shapes(self, data):
+        tr = make_trainer(data, optimization="sgd")
+        idx = np.arange(8)
+        trn = data.train
+        nt, nl = tr.sample_negatives([trn.labels[i] for i in idx])
+        loss, g = tr._vgf(
+            tr.w, jnp.asarray(trn.q_tokens[idx]), jnp.asarray(trn.q_len[idx]),
+            jnp.asarray(trn.a_tokens[idx]), jnp.asarray(trn.a_len[idx]),
+            jnp.asarray(nt), jnp.asarray(nl),
+        )
+        assert np.isfinite(float(loss))
+        assert g.shape == tr.w.shape
+        assert float(jnp.max(jnp.abs(g))) <= BICNN_DEFAULTS.grad_clip + 1e-6
+
+    def test_no_violation_means_zero_grad(self, data):
+        """An example whose every candidate satisfies the margin is skipped
+        (the goto-continue path, bicnn.lua:361-371) — zero loss, zero grad."""
+        tr = make_trainer(data, optimization="sgd", l2reg=0.0, margin=-10.0)
+        # margin=-10: s_pos - s_neg < -10 is impossible (scores in (0,1)),
+        # so NO candidate ever violates -> every example skipped.
+        idx = np.arange(8)
+        trn = data.train
+        nt, nl = tr.sample_negatives([trn.labels[i] for i in idx])
+        loss, g = tr._vgf(
+            tr.w, jnp.asarray(trn.q_tokens[idx]), jnp.asarray(trn.q_len[idx]),
+            jnp.asarray(trn.a_tokens[idx]), jnp.asarray(trn.a_len[idx]),
+            jnp.asarray(nt), jnp.asarray(nl),
+        )
+        assert float(loss) == 0.0
+        assert float(jnp.max(jnp.abs(g))) == 0.0
+
+    def test_reg_scales_with_contributing_examples(self, data):
+        """L2 term is added once per contributing example (bicnn.lua:392-397)."""
+        tr0 = make_trainer(data, optimization="sgd", l2reg=0.0, margin=0.9)
+        tr2 = make_trainer(data, optimization="sgd", l2reg=1e-3, margin=0.9)
+        idx = np.arange(8)
+        trn = data.train
+        nt, nl = tr0.sample_negatives([trn.labels[i] for i in idx])
+        args = (
+            jnp.asarray(trn.q_tokens[idx]), jnp.asarray(trn.q_len[idx]),
+            jnp.asarray(trn.a_tokens[idx]), jnp.asarray(trn.a_len[idx]),
+            jnp.asarray(nt), jnp.asarray(nl),
+        )
+        l0, _ = tr0._vgf(tr0.w, *args)
+        l2, _ = tr2._vgf(tr2.w, *args)  # same init -> same w
+        w = np.asarray(tr0.w)
+        # margin=0.9 is near-unachievable in (0,1) scores: all 8 contribute
+        want = float(l0) + 8 * 1e-3 * 0.5 * float(w @ w)
+        np.testing.assert_allclose(float(l2), want, rtol=1e-4)
+
+
+class TestLocalTraining:
+    def test_sgd_learns_above_chance(self, data):
+        tr = make_trainer(data, optimization="sgd", learning_rate=0.05,
+                          momentum=0.9, epoch=15, margin=0.1, l2reg=0.0)
+        result = tr.run()
+        # pools have 6 candidates -> chance ~= 1/6
+        assert result["accuracy"]["valid"] > 0.35
+        assert result["best"]["valid"]["acc"] >= result["accuracy"]["valid"] - 1e-9
+
+    def test_loadmodel_resume(self, data, tmp_path):
+        tr = make_trainer(data, optimization="sgd",
+                          outputprefix=str(tmp_path / "ck"))
+        tr._save_checkpoint()
+        saved = list(tmp_path.glob("ck_*.npz"))
+        assert saved
+        tr2 = make_trainer(data, optimization="sgd",
+                           loadmodel=str(tmp_path / "ck_latest.npz"))
+        np.testing.assert_allclose(np.asarray(tr2.w), np.asarray(tr.w))
+
+    def test_comm_opt_without_pclient_raises(self, data):
+        tr = make_trainer(data, optimization="downpour")
+        with pytest.raises(ValueError, match="parameter client"):
+            _ = tr.optimizer
+
+    def test_preload_binary_populates_cache(self, tmp_path):
+        """First preload_binary run builds + writes the cache; the second
+        run loads it (plaunch.lua:218-229 analog, without checked-in files)."""
+        cache = tmp_path / "qa_cache.npz"
+        cfg = BICNN_DEFAULTS.merged(TINY).merged(
+            preload_binary=True, binary_path=str(cache), optimization="sgd",
+        )
+        tr1 = BiCNNTrainer(cfg)
+        assert cache.exists()
+        tr2 = BiCNNTrainer(cfg)
+        assert tr2.data.source.startswith("binary")
+        np.testing.assert_array_equal(
+            tr1.data.train.q_tokens, tr2.data.train.q_tokens
+        )
+
+    def test_single_process_rejects_distributed_opt(self, data):
+        cfg = BICNN_LAUNCH_DEFAULTS.merged(TINY).merged(
+            np=1, optimization="adamsingle", valid_mode="none",
+        )
+        with pytest.raises(ValueError, match="sgd"):
+            run_rank(0, 1, cfg, transport=None, data=data)
+
+
+class TestAssignRoles:
+    def test_testerfirst(self):
+        s, c, t, tr = assign_roles(7, 2, testerfirst=True)
+        assert t == 0 and tr == {0}
+        assert s == [2, 4, 6]  # i % 2 == 0 for i in 1..6 (plaunch.lua:126-142)
+        assert c == [0, 1, 3, 5]
+
+    def test_testerlast(self):
+        s, c, t, tr = assign_roles(7, 2, testerlast=True)
+        assert t == 6 and tr == {6}
+        assert s == [1, 3, 5]  # (i+1) % 2 == 0 for i in 0..5 (plaunch.lua:145-160)
+        assert c == [0, 2, 4, 6]
+
+    def test_last_client_mode(self):
+        s, c, t, tr = assign_roles(6, 2, valid_mode="lastClient")
+        assert t is None and tr == {5}
+        assert s == [0, 2, 4] and c == [1, 3, 5]
+
+    def test_additional_tester_requires_flag(self):
+        with pytest.raises(ValueError, match="additionalTester"):
+            assign_roles(6, 2, valid_mode="additionalTester")
+
+    def test_mutually_exclusive(self):
+        with pytest.raises(ValueError, match="exclusive"):
+            assign_roles(6, 2, testerfirst=True, testerlast=True)
+
+
+class TestServerRule:
+    def test_adam_gets_stepdiv(self):
+        cfg = BICNN_DEFAULTS.merged(optimization="adam", step_div_adam=7)
+        rule = server_rule_for(cfg)
+        assert rule is not None  # binds without error; stepdiv path covered
+
+    def test_delta_opts_use_add(self):
+        for name in ("sgd", "downpour", "eamsgd"):
+            cfg = BICNN_DEFAULTS.merged(optimization=name)
+            assert server_rule_for(cfg) is not None
+
+
+def run_topology(size, cfg, data, timeout=600):
+    router = LocalRouter(size)
+    results, errors = {}, {}
+
+    def target(rank):
+        try:
+            results[rank] = run_rank(rank, size, cfg, router.endpoint(rank), data=data)
+        except BaseException as exc:  # noqa: BLE001
+            errors[rank] = exc
+
+    threads = [threading.Thread(target=target, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    if errors:
+        raise next(iter(errors.values()))
+    assert not any(t.is_alive() for t in threads), f"hung; done={list(results)}"
+    return results
+
+
+class TestTopologies:
+    def test_downpour_np4(self, data):
+        cfg = BICNN_LAUNCH_DEFAULTS.merged(TINY).merged(
+            np=4, optimization="downpour", learning_rate=0.05, epoch=1,
+            valid_mode="none",
+        )
+        results = run_topology(4, cfg, data)
+        roles = {r: res["role"] for r, res in results.items()}
+        assert roles == {0: "server", 1: "worker", 2: "server", 3: "worker"}
+        assert all(results[r]["grads_applied"] > 0 for r in (0, 2))
+
+    def test_eamsgd_with_tester_first(self, data, tmp_path):
+        cfg = BICNN_LAUNCH_DEFAULTS.merged(TINY).merged(
+            np=5, optimization="eamsgd", learning_rate=0.05, momentum=0.9,
+            movingrate=0.3, commperiod=2, epoch=1,
+            testerfirst=True, valid_mode="additionalTester",
+            tester_rounds=2, valid_sleep_time=0.05,
+            outputprefix=str(tmp_path / "bic"),
+        )
+        results = run_topology(5, cfg, data)
+        roles = {r: res["role"] for r, res in results.items()}
+        # size 5, testerfirst: tester=0, servers 2,4; workers 1,3
+        assert roles == {0: "tester", 1: "worker", 2: "server",
+                         3: "worker", 4: "server"}
+        assert len(results[0]["history"]) == 2
+        assert list(tmp_path.glob("bic_*.npz"))  # tester checkpoints
+
+    def test_adamsingle_np3(self, data):
+        cfg = BICNN_LAUNCH_DEFAULTS.merged(TINY).merged(
+            np=3, optimization="adamsingle", epoch=1, valid_mode="none",
+            master_freq=3,
+        )
+        # master_freq=3: rank 0 server, ranks 1-2 clients
+        results = run_topology(3, cfg, data)
+        assert results[0]["role"] == "server"
+
+    def test_parked_rank(self, data):
+        cfg = BICNN_LAUNCH_DEFAULTS.merged(TINY).merged(
+            np=5, optimization="downpour", epoch=1, valid_mode="none",
+            maxrank=3,
+        )
+        results = run_topology(5, cfg, data)
+        assert results[4]["role"] == "parked"
+        assert results[0]["role"] == "server"

@@ -1,0 +1,111 @@
+"""QA data pipeline: parsing, vocab/OOV, padding, binary cache, synthetic."""
+
+import numpy as np
+import pytest
+
+from mpit_tpu.data import qa
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    d = tmp_path_factory.mktemp("qa")
+    paths = qa.synthetic_qa(d, n_labels=10, n_train=40, n_eval=12,
+                            embedding_dim=6, vocab_words=50, seed=3)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def data(corpus):
+    return qa.load_qa_files(embedding_dim=6, conv_width=2, **corpus)
+
+
+class TestParsing:
+    def test_reserved_tokens(self, data):
+        assert data.vocab.str2idx["SENTBEGIN"] == qa.SENTBEGIN
+        assert data.vocab.str2idx["SENTEND"] == qa.SENTEND
+        # zero vectors for the sentinels (prepareData.lua:33-39)
+        assert not data.vocab.vectors[0].any()
+        assert not data.vocab.vectors[1].any()
+
+    def test_counts(self, data):
+        assert len(data.train) == 40
+        assert len(data.valid) == len(data.test1) == len(data.test2) == 12
+        assert data.answer_space == 10
+
+    def test_sentence_padding(self, data):
+        """conv_width SENTBEGINs then words then conv_width-1 SENTENDs
+        (prepareData.lua:90-102)."""
+        w = 2
+        for i in range(len(data.train)):
+            length = data.train.q_len[i]
+            row = data.train.q_tokens[i]
+            assert (row[:w] == qa.SENTBEGIN).all()
+            # with conv_width=2 the final valid token is one SENTEND
+            assert row[length - 1] == qa.SENTEND
+            assert (row[w : length - (w - 1)] > qa.SENTEND).all()
+
+    def test_oov_words_added(self, data):
+        # synthetic embeddings cover 3/4 of the vocab + topic words are OOV
+        n_pretrained = 50 * 3 // 4
+        assert len(data.vocab) > n_pretrained + 2
+
+    def test_oov_deterministic(self, corpus):
+        a = qa.load_qa_files(embedding_dim=6, conv_width=2, oov_seed=5, **corpus)
+        b = qa.load_qa_files(embedding_dim=6, conv_width=2, oov_seed=5, **corpus)
+        np.testing.assert_array_equal(a.vocab.matrix(), b.vocab.matrix())
+
+    def test_pools_reference_known_labels(self, data):
+        l2r = data.label2row
+        for pool in data.valid.pools:
+            assert all(v in l2r for v in pool)
+
+    def test_gold_label_in_pool(self, data):
+        for labels, pool in zip(data.valid.labels, data.valid.pools):
+            assert any(l in pool for l in labels)
+
+
+class TestPackSequences:
+    def test_pads_with_sentend(self):
+        tok, lengths = qa.pack_sequences([[0, 5, 3], [0, 7]])
+        assert tok.shape == (2, 3)
+        np.testing.assert_array_equal(lengths, [3, 2])
+        assert tok[1, 2] == qa.SENTEND
+
+    def test_min_width(self):
+        tok, _ = qa.pack_sequences([[4]], max_len=8)
+        assert tok.shape == (1, 8)
+
+
+class TestBinaryCache:
+    def test_roundtrip(self, data, tmp_path):
+        p = qa.save_binary(data, tmp_path / "cache.npz")
+        back = qa.load_binary(p)
+        np.testing.assert_array_equal(back.train.q_tokens, data.train.q_tokens)
+        np.testing.assert_array_equal(back.answer_tokens, data.answer_tokens)
+        np.testing.assert_array_equal(back.vocab.matrix(), data.vocab.matrix())
+        assert back.train.labels == data.train.labels
+        assert back.valid.pools == data.valid.pools
+        assert back.answer_labels == data.answer_labels
+        assert back.vocab.str2idx == data.vocab.str2idx
+
+    def test_load_qa_prefers_binary(self, data, tmp_path):
+        p = qa.save_binary(data, tmp_path / "cache.npz")
+        got = qa.load_qa(binary_path=p)
+        assert got.source.startswith("binary")
+        assert len(got.train) == len(data.train)
+
+
+class TestSyntheticFallback:
+    def test_load_qa_synthetic(self, tmp_path):
+        got = qa.load_qa(embedding_dim=6, conv_width=3, synthetic_dir=tmp_path,
+                         n_labels=8, n_train=20, n_eval=6, vocab_words=40)
+        assert got.source.startswith("synthetic")
+        assert len(got.train) == 20
+        # conv_width respected in the padding
+        assert (got.train.q_tokens[0][:3] == qa.SENTBEGIN).all()
+
+    def test_regeneration_is_deterministic(self, tmp_path):
+        a = qa.load_qa(embedding_dim=6, synthetic_dir=tmp_path / "a")
+        b = qa.load_qa(embedding_dim=6, synthetic_dir=tmp_path / "b")
+        np.testing.assert_array_equal(a.train.q_tokens, b.train.q_tokens)
+        np.testing.assert_array_equal(a.vocab.matrix(), b.vocab.matrix())
