@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import pathlib
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -165,6 +166,10 @@ class QAData:
     answer_tokens: np.ndarray  # (A, La) int32
     answer_len: np.ndarray  # (A,)
     source: str = "files"
+    # SENTBEGIN/SENTEND padding width the corpus was encoded with; recorded
+    # in the binary cache so a stale cache can't silently feed a model built
+    # for a different cont_conv_width (the padding is baked into the tokens).
+    conv_width: int = 0
 
     @property
     def label2row(self) -> Dict[int, int]:
@@ -246,17 +251,23 @@ def load_qa_files(
     labels, ans_tokens, ans_len = parse_label2answers(
         pathlib.Path(label2answ_file), vocab, conv_width
     )
-    return QAData(vocab, train, valid, test1, test2, labels, ans_tokens, ans_len)
+    return QAData(vocab, train, valid, test1, test2, labels, ans_tokens,
+                  ans_len, conv_width=conv_width)
 
 
 # -- binary cache (the preloadBinary path, plaunch.lua:218-229) --------------
 
 
 def save_binary(data: QAData, path: pathlib.Path) -> pathlib.Path:
-    """One .npz holding every array + a JSON blob for the ragged parts."""
+    """One .npz holding every array + a JSON blob for the ragged parts.
+
+    The write is atomic (temp file + ``os.replace``) so concurrent gang
+    ranks sharing one cache path read either the old complete file or the
+    new one — never a torn archive."""
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     ragged = {
+        "conv_width": data.conv_width,
         "idx2str": data.vocab.idx2str,
         "train_labels": data.train.labels,
         "valid_labels": data.valid.labels,
@@ -268,24 +279,50 @@ def save_binary(data: QAData, path: pathlib.Path) -> pathlib.Path:
         "answer_labels": data.answer_labels,
         "embedding_dim": data.vocab.embedding_dim,
     }
-    np.savez_compressed(
-        path,
-        embeddings=data.vocab.matrix(),
-        train_q=data.train.q_tokens, train_ql=data.train.q_len,
-        train_a=data.train.a_tokens, train_al=data.train.a_len,
-        valid_q=data.valid.q_tokens, valid_ql=data.valid.q_len,
-        test1_q=data.test1.q_tokens, test1_ql=data.test1.q_len,
-        test2_q=data.test2.q_tokens, test2_ql=data.test2.q_len,
-        answer_tokens=data.answer_tokens, answer_len=data.answer_len,
-        ragged=np.frombuffer(json.dumps(ragged).encode(), np.uint8),
-    )
+    tmp = path.with_name(f".{path.name}.tmp{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:  # file object: savez won't munge suffixes
+            np.savez_compressed(
+                f,
+                embeddings=data.vocab.matrix(),
+                train_q=data.train.q_tokens, train_ql=data.train.q_len,
+                train_a=data.train.a_tokens, train_al=data.train.a_len,
+                valid_q=data.valid.q_tokens, valid_ql=data.valid.q_len,
+                test1_q=data.test1.q_tokens, test1_ql=data.test1.q_len,
+                test2_q=data.test2.q_tokens, test2_ql=data.test2.q_len,
+                answer_tokens=data.answer_tokens, answer_len=data.answer_len,
+                ragged=np.frombuffer(json.dumps(ragged).encode(), np.uint8),
+            )
+        os.replace(tmp, path)
+    finally:
+        tmp.unlink(missing_ok=True)
     return path
 
 
-def load_binary(path: pathlib.Path) -> QAData:
+def load_binary(
+    path: pathlib.Path,
+    expect_conv_width: int | None = None,
+    expect_embedding_dim: int | None = None,
+) -> QAData:
+    """Load the .npz cache; if expectations are given, reject a cache built
+    under a different config (its token padding/vectors would silently
+    mismatch the model — conv_width is baked into every sentence)."""
     with np.load(path, allow_pickle=False) as z:
         ragged = json.loads(bytes(z["ragged"]).decode())
-        vocab = QAVocab(int(ragged["embedding_dim"]))
+        cached_cw = int(ragged.get("conv_width", 0))
+        cached_dim = int(ragged["embedding_dim"])
+        if expect_conv_width is not None and cached_cw != expect_conv_width:
+            raise ValueError(
+                f"binary cache {path} was built with conv_width={cached_cw}, "
+                f"config wants {expect_conv_width}; delete the cache or fix "
+                "binary_path"
+            )
+        if expect_embedding_dim is not None and cached_dim != expect_embedding_dim:
+            raise ValueError(
+                f"binary cache {path} was built with embedding_dim="
+                f"{cached_dim}, config wants {expect_embedding_dim}"
+            )
+        vocab = QAVocab(cached_dim)
         mat = z["embeddings"]
         vocab.str2idx = {w: i for i, w in enumerate(ragged["idx2str"])}
         vocab.idx2str = list(ragged["idx2str"])
@@ -300,7 +337,7 @@ def load_binary(path: pathlib.Path) -> QAData:
         return QAData(
             vocab, train, valid, test1, test2,
             list(ragged["answer_labels"]), z["answer_tokens"], z["answer_len"],
-            source=f"binary ({path})",
+            source=f"binary ({path})", conv_width=cached_cw,
         )
 
 
@@ -394,17 +431,27 @@ def synthetic_qa(
 
 
 def load_qa(
-    embedding_dim: int = 100,
-    conv_width: int = 2,
+    embedding_dim: Optional[int] = None,
+    conv_width: Optional[int] = None,
     paths: Optional[Dict[str, pathlib.Path]] = None,
     binary_path: Optional[pathlib.Path] = None,
     synthetic_dir: Optional[pathlib.Path] = None,
     oov_seed: int = 0,
     **synthetic_kwargs,
 ) -> QAData:
-    """Resolve the best available source: binary cache > files > synthetic."""
+    """Resolve the best available source: binary cache > files > synthetic.
+
+    When loading from the binary cache, explicitly-passed ``conv_width`` /
+    ``embedding_dim`` are validated against the values the cache was built
+    with; left as None they accept whatever the cache holds."""
     if binary_path and pathlib.Path(binary_path).exists():
-        return load_binary(pathlib.Path(binary_path))
+        return load_binary(
+            pathlib.Path(binary_path),
+            expect_conv_width=conv_width,
+            expect_embedding_dim=embedding_dim,
+        )
+    embedding_dim = 100 if embedding_dim is None else embedding_dim
+    conv_width = 2 if conv_width is None else conv_width
     if paths is None:
         import tempfile
 

@@ -1,0 +1,40 @@
+"""TPU pallas kernels for the framework's hot ops.
+
+The reference's hot loops are in-place torch tensor math — the server's
+``p:add(g)`` and per-rule optimizer updates (reference
+asyncsgd/pserver.lua:83, BiCNN/pserver.lua:123-197) and the client's
+Nesterov/elastic updates (reference asyncsgd/optim-msgd.lua:36-39,
+optim-eamsgd.lua:58-66).  On TPU those are HBM-bandwidth-bound elementwise
+passes; the kernels here fuse each multi-array update into a single
+HBM read/write sweep with buffer donation (no param-sized temporaries).
+:mod:`mpit_tpu.ops.flash_attention` adds the blockwise-attention kernel
+that backs sequence-parallel ring attention
+(:mod:`mpit_tpu.parallel.ring_attention`).
+
+Every op has a jnp reference implementation (``*_reference``) used for
+testing and as a CPU fallback; kernels run in pallas interpret mode off-TPU
+so the whole package is exercised by the CPU test suite.
+"""
+
+from mpit_tpu.ops.fused_update import (
+    fused_adam,
+    fused_adam_reference,
+    fused_elastic,
+    fused_elastic_reference,
+    fused_nesterov_commit,
+    fused_nesterov_commit_reference,
+)
+from mpit_tpu.ops.flash_attention import (
+    attention_reference,
+    block_attention_partial,
+    flash_attention,
+)
+from mpit_tpu.ops.tiles import as_rows, from_rows
+
+__all__ = [
+    "fused_nesterov_commit", "fused_nesterov_commit_reference",
+    "fused_adam", "fused_adam_reference",
+    "fused_elastic", "fused_elastic_reference",
+    "flash_attention", "attention_reference", "block_attention_partial",
+    "as_rows", "from_rows",
+]

@@ -1,0 +1,279 @@
+"""Blockwise (flash) attention for TPU — standalone op and ring building
+block.
+
+The reference has no attention at all (conv/pool models only — SURVEY.md
+§5 "long-context: absent"); this op is the TPU-native long-context
+showcase the rebuild adds on top of capability parity.  Design:
+
+- MXU-shaped: scores and the PV product are ``jnp.dot`` with
+  ``preferred_element_type=f32``; blocks are (block_q, block_k) tiles with
+  the head dim padded to a lane multiple (128).
+- Online softmax: running row-max ``m``, normalizer ``l`` and
+  unnormalized accumulator carried across k-blocks in VMEM scratch —
+  O(Lq·D) memory regardless of Lk.
+- **Global-offset causal masking**: ``q_offset``/``kv_offset`` (traced
+  scalars) shift local indices into global sequence positions, which is
+  exactly what sequence-parallel ring attention needs — each ring step
+  attends a local Q chunk against a remote KV chunk
+  (:mod:`mpit_tpu.parallel.ring_attention`).
+- ``kv_len`` masks padded keys so inputs need not be block-multiples.
+
+:func:`flash_attention` is the user op (normalized output, custom VJP:
+backward recomputes via the jnp reference — O(Lq·Lk) per call, which in
+the ring layout is per-chunk, i.e. already blockwise).
+:func:`block_attention_partial` returns unnormalized partials
+``(acc, m, l)`` for cross-chunk merging; :func:`merge_partials` /
+:func:`finalize_partials` implement the log-sum-exp combine.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpit_tpu.ops.tiles import (
+    LANE, round_up as _round_up, use_interpret as _interpret,
+)
+
+NEG_INF = float("-inf")
+
+
+# ---------------------------------------------------------------------------
+# jnp reference + partial/merge algebra (differentiable, CPU-friendly)
+# ---------------------------------------------------------------------------
+
+
+def _mask(sh_q: int, sh_k: int, q_offset, kv_offset, kv_len, causal: bool):
+    """Boolean (Lq, Lk) validity mask in *global* coordinates."""
+    qi = q_offset + jnp.arange(sh_q)[:, None]
+    kj = kv_offset + jnp.arange(sh_k)[None, :]
+    valid = (kj - kv_offset) < kv_len
+    if causal:
+        valid = valid & (qi >= kj)
+    return valid
+
+
+def attention_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    q_offset=0,
+    kv_offset=0,
+) -> jnp.ndarray:
+    """Plain softmax attention over the last two axes; leading axes batch.
+    Rows with no valid key return zeros (matches the ring/partial path)."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    valid = _mask(q.shape[-2], k.shape[-2], q_offset, kv_offset,
+                  k.shape[-2], causal)
+    s = jnp.where(valid, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.where(valid, jnp.exp(s - m_safe), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return (out / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
+
+
+def block_attention_partial(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    q_offset=0,
+    kv_offset=0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Unnormalized attention partials for one (Q chunk, KV chunk) pair:
+    ``acc = exp(s - m) @ v``, rowwise max ``m`` and normalizer ``l``, all
+    f32.  Differentiable jnp implementation — the per-ring-step op of
+    :func:`mpit_tpu.parallel.ring_attention.ring_attention`."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    valid = _mask(q.shape[-2], k.shape[-2], q_offset, kv_offset,
+                  k.shape[-2], causal)
+    s = jnp.where(valid, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.where(valid, jnp.exp(s - m_safe[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def merge_partials(a, b):
+    """Log-sum-exp combine of two ``(acc, m, l)`` partials (the cross-step
+    merge of ring attention; associative and commutative)."""
+    acc1, m1, l1 = a
+    acc2, m2, l2 = b
+    m = jnp.maximum(m1, m2)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    c1 = jnp.where(jnp.isneginf(m1), 0.0, jnp.exp(m1 - m_safe))
+    c2 = jnp.where(jnp.isneginf(m2), 0.0, jnp.exp(m2 - m_safe))
+    acc = acc1 * c1[..., None] + acc2 * c2[..., None]
+    l = l1 * c1 + l2 * c2
+    return acc, m, l
+
+
+def finalize_partials(acc, l, dtype=jnp.float32):
+    """Normalize merged partials; all-masked rows yield zeros."""
+    return (acc / jnp.where(l == 0.0, 1.0, l)[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_scr, m_scr, l_scr, *, causal, scale, block_q, block_k):
+    i, j = pl.program_id(0), pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    qf = q_ref[:].astype(jnp.float32)
+    kf = k_ref[:].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (block_q, block_k)
+
+    qi = (qoff_ref[0, 0] + i * block_q
+          + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+    kj_local = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = kj_local < kvlen_ref[0, 0]
+    if causal:
+        valid = valid & (qi >= kvoff_ref[0, 0] + kj_local)
+    s = jnp.where(valid, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.where(valid, jnp.exp(s - m_safe), 0.0)
+    alpha = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_safe))
+    l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p, v_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[:] = acc_scr[:] * alpha + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[:] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
+           block_k, interpret):
+    """Core call on (Lq, D) x (Lk, D); pads to tiles, returns (Lq, D)."""
+    lq, d = q.shape
+    lk = k.shape[0]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    bq = min(block_q, _round_up(lq, 8))
+    bk = min(block_k, _round_up(lk, LANE))
+    lq_p, lk_p, d_p = _round_up(lq, bq), _round_up(lk, bk), _round_up(d, LANE)
+    qp = jnp.pad(q, ((0, lq_p - lq), (0, d_p - d)))
+    kp = jnp.pad(k, ((0, lk_p - lk), (0, d_p - d)))
+    vp = jnp.pad(v, ((0, lk_p - lk), (0, d_p - d)))
+    grid = (lq_p // bq, lk_p // bk)
+
+    sspec = pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+    out = pl.pallas_call(
+        functools.partial(
+            _fa_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk
+        ),
+        grid=grid,
+        in_specs=[
+            sspec, sspec, sspec,
+            pl.BlockSpec((bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, d_p), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, d_p), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((lq_p, d_p), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d_p), jnp.float32),
+            pltpu.VMEM((bq, LANE), jnp.float32),
+            pltpu.VMEM((bq, LANE), jnp.float32),
+        ],
+        interpret=_interpret(interpret),
+    )(
+        jnp.asarray(q_offset, jnp.int32).reshape(1, 1),
+        jnp.asarray(kv_offset, jnp.int32).reshape(1, 1),
+        jnp.asarray(lk, jnp.int32).reshape(1, 1),
+        qp, kp, vp,
+    )
+    return out[:lq, :d]
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash(causal, sm_scale, block_q, block_k, interpret):
+    """Differentiable flash op for fixed static config: pallas forward,
+    recompute-backward through the jnp reference."""
+
+    @jax.custom_vjp
+    def fa(q, k, v, q_offset, kv_offset):
+        f = lambda q2, k2, v2: _fa_2d(
+            q2, k2, v2, q_offset, kv_offset, causal=causal,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+        for _ in range(q.ndim - 2):
+            f = jax.vmap(f)
+        return f(q, k, v)
+
+    def fwd(q, k, v, q_offset, kv_offset):
+        return fa(q, k, v, q_offset, kv_offset), (q, k, v, q_offset, kv_offset)
+
+    def bwd(res, g):
+        q, k, v, q_offset, kv_offset = res
+        ref = functools.partial(
+            attention_reference, causal=causal, sm_scale=sm_scale,
+            q_offset=q_offset, kv_offset=kv_offset,
+        )
+        _, vjp = jax.vjp(ref, q, k, v)
+        dq, dk, dv = vjp(g.astype(q.dtype))
+        return dq, dk, dv, None, None
+
+    fa.defvjp(fwd, bwd)
+    return fa
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    q_offset=0,
+    kv_offset=0,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Flash attention over ``(..., L, D)`` with global-offset causal
+    masking.  Leading axes are batched (vmapped); offsets may be traced."""
+    fa = _make_flash(bool(causal), sm_scale, int(block_q), int(block_k),
+                     _interpret(interpret))
+    return fa(q, k, v, jnp.asarray(q_offset, jnp.int32),
+              jnp.asarray(kv_offset, jnp.int32))

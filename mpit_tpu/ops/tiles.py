@@ -1,0 +1,57 @@
+"""Flat-vector <-> VPU-tile reshaping for 1-D elementwise kernels.
+
+The framework's parameter state is flat 1-D vectors (the reference's
+``getParameters()`` contract, reference goot.lua:29-36) of arbitrary
+length.  TPU vector memory is tiled ``(sublane, 128)``; these helpers pad
+a flat vector to a ``(rows, 128)`` array whose row count is a multiple of
+the kernel's row-block, so a pallas grid can sweep it with fully-aligned
+blocks and no ragged-edge masking.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+LANE = 128
+SUBLANE = 8
+MAX_BLOCK_ROWS = 256  # 256x128 f32 = 128 KiB per ref — a few refs fit VMEM
+
+
+def round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def use_interpret(flag: bool | None) -> bool:
+    """Pallas interpret-mode default: interpret everywhere but real TPU,
+    so the whole kernel suite runs under the CPU test harness."""
+    return jax.default_backend() != "tpu" if flag is None else bool(flag)
+
+
+def block_rows_for(n: int) -> int:
+    """Row-block height for an n-element flat vector: whole array when it
+    is small (grid of 1), MAX_BLOCK_ROWS sweeps otherwise."""
+    rows = round_up(max(n, 1), LANE) // LANE
+    return min(MAX_BLOCK_ROWS, round_up(rows, SUBLANE))
+
+
+def as_rows(x: jnp.ndarray, block_rows: int | None = None) -> Tuple[jnp.ndarray, int]:
+    """Pad a 1-D array with zeros and reshape to (rows, 128), rows a
+    multiple of ``block_rows``.  Returns (tiled, original_length)."""
+    if x.ndim != 1:
+        raise ValueError(f"as_rows expects 1-D, got shape {x.shape}")
+    n = x.shape[0]
+    if block_rows is None:
+        block_rows = block_rows_for(n)
+    rows = round_up(round_up(max(n, 1), LANE) // LANE, block_rows)
+    pad = rows * LANE - n
+    if pad:
+        x = jnp.pad(x, (0, pad))
+    return x.reshape(rows, LANE), n
+
+
+def from_rows(tiled: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Inverse of :func:`as_rows`."""
+    return tiled.reshape(-1)[:n]

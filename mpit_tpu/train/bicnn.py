@@ -242,7 +242,11 @@ class BiCNNTrainer:
             cfg.preload_binary and cfg.binary_path
         ) else None
         if cache is not None and cache.exists():
-            return load_qa(binary_path=cache)
+            return load_qa(
+                binary_path=cache,
+                conv_width=cfg.cont_conv_width,
+                embedding_dim=cfg.embedding_dim,
+            )
         file_keys = ("embedding_file", "train_file", "valid_file",
                      "test_file1", "test_file2", "label2answ_file")
         if all(cfg.get(k, "none") != "none" for k in file_keys):

@@ -80,9 +80,18 @@ def assign_roles(
         # No dedicated tester: the asyncsgd parity split (mlaunch.lua:25-31).
         for i in range(size):
             (sranks if i % master_freq == 0 else cranks).append(i)
+    training_clients = [c for c in cranks if c != tester_rank]
+    if not sranks or not training_clients:
+        raise ValueError(
+            f"role split produced {len(sranks)} servers and no training "
+            f"clients from size={size}, master_freq={master_freq}"
+        )
     tranks: Set[int] = set()
     if valid_mode == "lastClient":
-        tranks.add(size - 1)  # plaunch.lua:166-167
+        # The highest-ranked *training client* (plaunch.lua:166-167 adds
+        # size-1, which there is always a client; here the last rank may
+        # be a server, so pick the last rank that actually trains).
+        tranks.add(training_clients[-1])
     elif valid_mode == "additionalTester":
         if tester_rank is None:
             # plaunch.lua:169-177 errors on this combination too.
@@ -92,11 +101,6 @@ def assign_roles(
         tranks.add(tester_rank)
     elif valid_mode != "none":
         raise ValueError(f"unknown valid_mode {valid_mode!r}")
-    if not sranks or not [c for c in cranks if c != tester_rank]:
-        raise ValueError(
-            f"role split produced {len(sranks)} servers and no training "
-            f"clients from size={size}, master_freq={master_freq}"
-        )
     return sranks, cranks, tester_rank, tranks
 
 

@@ -160,6 +160,12 @@ class TestAssignRoles:
         assert t is None and tr == {5}
         assert s == [0, 2, 4] and c == [1, 3, 5]
 
+    def test_last_client_skips_server_rank(self):
+        # size=7, mf=2: rank 6 is a server — the eval mark must land on the
+        # last *training client* (5), not on a rank that never trains.
+        s, c, t, tr = assign_roles(7, 2, valid_mode="lastClient")
+        assert 6 in s and tr == {5} and 5 in c
+
     def test_additional_tester_requires_flag(self):
         with pytest.raises(ValueError, match="additionalTester"):
             assign_roles(6, 2, valid_mode="additionalTester")

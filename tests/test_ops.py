@@ -1,0 +1,176 @@
+"""Pallas ops vs their jnp references (interpret mode on the CPU suite).
+
+The reference frames its "unit tests" as runnable scripts checked by eye
+(SURVEY.md §4); here every kernel is pinned to a pure-jnp reference
+implementation with tolerances, the golden-value style the rebuild's test
+strategy mandates.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.ops import (
+    as_rows,
+    attention_reference,
+    block_attention_partial,
+    flash_attention,
+    from_rows,
+    fused_adam,
+    fused_adam_reference,
+    fused_elastic,
+    fused_elastic_reference,
+    fused_nesterov_commit,
+    fused_nesterov_commit_reference,
+)
+from mpit_tpu.ops.flash_attention import finalize_partials, merge_partials
+from mpit_tpu.optim.rules import adam_apply, adam_init
+
+
+@pytest.mark.parametrize("n", [7, 128, 1024, 5000])
+def test_tiles_roundtrip(rng, n):
+    x = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    tiled, m = as_rows(x)
+    assert tiled.ndim == 2 and tiled.shape[1] == 128
+    np.testing.assert_array_equal(np.asarray(from_rows(tiled, m)), np.asarray(x))
+
+
+@pytest.mark.parametrize("n", [100, 33000])
+@pytest.mark.parametrize("l2wd", [0.0, 0.01])
+def test_fused_nesterov(rng, n, l2wd):
+    w, vt, g = (jnp.asarray(rng.normal(size=(n,)), jnp.float32) for _ in range(3))
+    clr = jnp.float32(0.05)
+    w1, vt1 = fused_nesterov_commit(w, vt, g, clr, l2wd=l2wd)
+    w2, vt2 = fused_nesterov_commit_reference(w, vt, g, clr, l2wd=l2wd)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w2), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vt1), np.asarray(vt2), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_nesterov_jit_traced_lr(rng):
+    w, vt, g = (jnp.asarray(rng.normal(size=(500,)), jnp.float32) for _ in range(3))
+
+    @jax.jit
+    def step(w, vt, g, clr):
+        return fused_nesterov_commit(w, vt, g, clr)
+
+    w1, vt1 = step(w, vt, g, jnp.float32(0.1))
+    w2, vt2 = fused_nesterov_commit_reference(w, vt, g, 0.1)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w2), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vt1), np.asarray(vt2), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_adam_matches_rule(rng):
+    """Kernel + external bias-correction == optim.rules adam_apply."""
+    n = 2000
+    p = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    st = adam_init(p)
+    lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+    p_ref, st_ref = p, st
+    p_k, m_k, v_k, t = p, st["m"], st["v"], 0
+    for _ in range(3):
+        g = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+        p_ref, st_ref = adam_apply(
+            p_ref, g, st_ref, lr=lr, beta1=b1, beta2=b2, epsilon=eps
+        )
+        t += 1
+        lr_t = lr * np.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+        p_k, m_k, v_k = fused_adam(
+            p_k, g, m_k, v_k, lr_t, beta1=b1, beta2=b2, epsilon=eps
+        )
+    np.testing.assert_allclose(np.asarray(p_k), np.asarray(p_ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m_k), np.asarray(st_ref["m"]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v_k), np.asarray(st_ref["v"]), rtol=1e-5, atol=1e-6)
+    ref = fused_adam_reference(p, g, st["m"], st["v"], lr)
+    assert all(r.shape == p.shape for r in ref)
+
+
+def test_fused_elastic(rng):
+    n = 3000
+    w = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    c = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    w1, sug1 = fused_elastic(w, c, 0.15)
+    w2, sug2 = fused_elastic_reference(w, c, 0.15)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w2), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sug1), np.asarray(sug2), rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+
+def _qkv(rng, shape):
+    return tuple(
+        jnp.asarray(rng.normal(size=shape) * 0.5, jnp.float32) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(64, 16), (2, 3, 40, 24)])
+def test_flash_matches_reference(rng, causal, shape):
+    q, k, v = _qkv(rng, shape)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=128)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_offsets_match_slicing(rng):
+    """Offset-masked chunk attention == the matching slice of global
+    causal attention (the ring-attention contract)."""
+    L, D, C = 32, 16, 8
+    q, k, v = _qkv(rng, (L, D))
+    full = attention_reference(q, k, v, causal=True)
+    for qi in range(L // C):
+        parts = [
+            block_attention_partial(
+                q[qi * C:(qi + 1) * C], k[kj * C:(kj + 1) * C],
+                v[kj * C:(kj + 1) * C], causal=True,
+                q_offset=qi * C, kv_offset=kj * C,
+            )
+            for kj in range(L // C)
+        ]
+        acc, m, l = parts[0]
+        for p in parts[1:]:
+            acc, m, l = merge_partials((acc, m, l), p)
+        merged = finalize_partials(acc, l)
+        np.testing.assert_allclose(
+            np.asarray(merged), np.asarray(full[qi * C:(qi + 1) * C]), atol=2e-5
+        )
+
+
+def test_flash_offsets_pallas(rng):
+    """The pallas kernel honors traced offsets (chunk vs global slice)."""
+    L, D, C = 32, 16, 16
+    q, k, v = _qkv(rng, (L, D))
+    full = attention_reference(q, k, v, causal=True)
+    out = flash_attention(
+        q[C:], k, v, causal=True, q_offset=jnp.int32(C), block_q=16, block_k=128
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full[C:]), atol=2e-5)
+
+
+def test_flash_grad_matches_reference(rng):
+    q, k, v = _qkv(rng, (24, 16))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=8, block_k=128) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_flash_ragged_lengths(rng):
+    """Non-block-multiple Lq/Lk/D are padded and masked correctly."""
+    q, k, v = _qkv(rng, (19, 12))
+    k2, v2 = k[:13], v[:13]
+    out = flash_attention(q, k2, v2, block_q=8, block_k=128)
+    ref = attention_reference(q, k2, v2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

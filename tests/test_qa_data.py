@@ -94,6 +94,18 @@ class TestBinaryCache:
         assert got.source.startswith("binary")
         assert len(got.train) == len(data.train)
 
+    def test_cache_rejects_config_mismatch(self, data, tmp_path):
+        p = qa.save_binary(data, tmp_path / "cache.npz")
+        with pytest.raises(ValueError, match="conv_width"):
+            qa.load_qa(binary_path=p, conv_width=data.conv_width + 1)
+        with pytest.raises(ValueError, match="embedding_dim"):
+            qa.load_qa(binary_path=p,
+                       embedding_dim=data.vocab.embedding_dim + 1)
+        # matching expectations load fine
+        got = qa.load_qa(binary_path=p, conv_width=data.conv_width,
+                         embedding_dim=data.vocab.embedding_dim)
+        assert got.conv_width == data.conv_width
+
 
 class TestSyntheticFallback:
     def test_load_qa_synthetic(self, tmp_path):
