@@ -309,9 +309,10 @@ def load_binary(
     mismatch the model — conv_width is baked into every sentence)."""
     with np.load(path, allow_pickle=False) as z:
         ragged = json.loads(bytes(z["ragged"]).decode())
-        cached_cw = int(ragged.get("conv_width", 0))
+        cached_cw = ragged.get("conv_width")  # None: pre-recording cache
         cached_dim = int(ragged["embedding_dim"])
-        if expect_conv_width is not None and cached_cw != expect_conv_width:
+        if (expect_conv_width is not None and cached_cw is not None
+                and int(cached_cw) != expect_conv_width):
             raise ValueError(
                 f"binary cache {path} was built with conv_width={cached_cw}, "
                 f"config wants {expect_conv_width}; delete the cache or fix "
@@ -337,7 +338,7 @@ def load_binary(
         return QAData(
             vocab, train, valid, test1, test2,
             list(ragged["answer_labels"]), z["answer_tokens"], z["answer_len"],
-            source=f"binary ({path})", conv_width=cached_cw,
+            source=f"binary ({path})", conv_width=int(cached_cw or 0),
         )
 
 

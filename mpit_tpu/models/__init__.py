@@ -12,9 +12,11 @@ from mpit_tpu.models.mnist import MnistCNN, MnistLinear, MnistMLP
 from mpit_tpu.models.flat import FlatModel, flatten_module
 from mpit_tpu.models.bicnn import BiCNN, BiCNNTower, gesd, margin_ranking_loss
 from mpit_tpu.models.layers import divide_constant, lp_normalize, masked_max_pool
+from mpit_tpu.models.transformer import DecoderBlock, TinyDecoder, default_attn
 
 __all__ = [
     "MnistLinear", "MnistMLP", "MnistCNN", "FlatModel", "flatten_module",
     "BiCNN", "BiCNNTower", "gesd", "margin_ranking_loss",
     "lp_normalize", "divide_constant", "masked_max_pool",
+    "TinyDecoder", "DecoderBlock", "default_attn",
 ]

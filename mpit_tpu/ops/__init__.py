@@ -27,7 +27,10 @@ from mpit_tpu.ops.fused_update import (
 from mpit_tpu.ops.flash_attention import (
     attention_reference,
     block_attention_partial,
+    finalize_partials,
     flash_attention,
+    flash_attention_partial,
+    merge_partials,
 )
 from mpit_tpu.ops.tiles import as_rows, from_rows
 
@@ -35,6 +38,7 @@ __all__ = [
     "fused_nesterov_commit", "fused_nesterov_commit_reference",
     "fused_adam", "fused_adam_reference",
     "fused_elastic", "fused_elastic_reference",
-    "flash_attention", "attention_reference", "block_attention_partial",
+    "flash_attention", "flash_attention_partial", "attention_reference",
+    "block_attention_partial", "merge_partials", "finalize_partials",
     "as_rows", "from_rows",
 ]

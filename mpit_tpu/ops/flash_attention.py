@@ -136,7 +136,11 @@ def finalize_partials(acc, l, dtype=jnp.float32):
 
 
 def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
-               acc_scr, m_scr, l_scr, *, causal, scale, block_q, block_k):
+               *rest, causal, scale, block_q, block_k, partial):
+    if partial:
+        m_out, l_out, acc_scr, m_scr, l_scr = rest
+    else:
+        acc_scr, m_scr, l_scr = rest
     i, j = pl.program_id(0), pl.program_id(1)
     nj = pl.num_programs(1)
 
@@ -146,43 +150,64 @@ def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    qf = q_ref[:].astype(jnp.float32)
-    kf = k_ref[:].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (block_q, block_k)
-
-    qi = (qoff_ref[0, 0] + i * block_q
-          + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
-    kj_local = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    valid = kj_local < kvlen_ref[0, 0]
+    # Skip blocks with no live element: entirely past kv_len padding, or
+    # (causal) entirely above the diagonal — the scratch carries through
+    # unchanged, saving the MXU work for ~half the blocks of a causal
+    # sweep.
+    live = j * block_k < kvlen_ref[0, 0]
     if causal:
-        valid = valid & (qi >= kvoff_ref[0, 0] + kj_local)
-    s = jnp.where(valid, s, NEG_INF)
+        q_max = qoff_ref[0, 0] + i * block_q + (block_q - 1)
+        k_min = kvoff_ref[0, 0] + j * block_k
+        live = jnp.logical_and(live, q_max >= k_min)
 
-    m_prev = m_scr[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-    p = jnp.where(valid, jnp.exp(s - m_safe), 0.0)
-    alpha = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_safe))
-    l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jax.lax.dot_general(
-        p, v_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    acc_scr[:] = acc_scr[:] * alpha + pv
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    @pl.when(live)
+    def _block():
+        qf = q_ref[:].astype(jnp.float32)
+        kf = k_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k)
+
+        qi = (qoff_ref[0, 0] + i * block_q
+              + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        kj_local = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = kj_local < kvlen_ref[0, 0]
+        if causal:
+            valid = valid & (qi >= kvoff_ref[0, 0] + kj_local)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.where(valid, jnp.exp(s - m_safe), 0.0)
+        alpha = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_safe))
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     @pl.when(j == nj - 1)
     def _finalize():
-        l = l_scr[:, :1]
-        o_ref[:] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        if partial:
+            o_ref[:] = acc_scr[:]
+            m_out[:] = m_scr[:]
+            l_out[:] = l_scr[:]
+        else:
+            l = l_scr[:, :1]
+            o_ref[:] = (
+                acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
+            ).astype(o_ref.dtype)
 
 
 def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
-           block_k, interpret):
-    """Core call on (Lq, D) x (Lk, D); pads to tiles, returns (Lq, D)."""
+           block_k, interpret, partial=False):
+    """Core call on (Lq, D) x (Lk, D); pads to tiles.  Returns the
+    normalized (Lq, D) output, or with ``partial`` the unnormalized
+    ``(acc, m, l)`` triple (f32) for cross-chunk merging."""
     lq, d = q.shape
     lk = k.shape[0]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -195,21 +220,31 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
     grid = (lq_p // bq, lk_p // bk)
 
     sspec = pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
-    out = pl.pallas_call(
+    qspec = pl.BlockSpec((bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((bq, LANE), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+    if partial:
+        out_specs = (qspec, rowspec, rowspec)
+        out_shape = (
+            jax.ShapeDtypeStruct((lq_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((lq_p, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((lq_p, LANE), jnp.float32),
+        )
+    else:
+        out_specs = qspec
+        out_shape = jax.ShapeDtypeStruct((lq_p, d_p), q.dtype)
+    res = pl.pallas_call(
         functools.partial(
-            _fa_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk
+            _fa_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk,
+            partial=partial,
         ),
         grid=grid,
         in_specs=[
-            sspec, sspec, sspec,
-            pl.BlockSpec((bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            sspec, sspec, sspec, qspec,
             pl.BlockSpec((bk, d_p), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bk, d_p), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(
-            (bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((lq_p, d_p), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, d_p), jnp.float32),
             pltpu.VMEM((bq, LANE), jnp.float32),
@@ -222,7 +257,36 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
         jnp.asarray(lk, jnp.int32).reshape(1, 1),
         qp, kp, vp,
     )
-    return out[:lq, :d]
+    if partial:
+        acc, m, l = res
+        return acc[:lq, :d], m[:lq, 0], l[:lq, 0]
+    return res[:lq, :d]
+
+
+def flash_attention_partial(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    q_offset=0,
+    kv_offset=0,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pallas twin of :func:`block_attention_partial`: unnormalized
+    ``(acc, m, l)`` over ``(..., L, D)``.  Forward-only — ring attention
+    wraps it in a custom VJP at the ring level
+    (:mod:`mpit_tpu.parallel.ring_attention`)."""
+    f = lambda q2, k2, v2: _fa_2d(
+        q2, k2, v2, q_offset, kv_offset, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, interpret=interpret, partial=True,
+    )
+    for _ in range(q.ndim - 2):
+        f = jax.vmap(f)
+    return f(q, k, v)
 
 
 @functools.lru_cache(maxsize=None)

@@ -23,4 +23,8 @@ from mpit_tpu.parallel.collective import (  # noqa: F401
     ring_shift,
 )
 from mpit_tpu.parallel.easgd import MeshEASGD  # noqa: F401
+from mpit_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    sp_mesh,
+)
 from mpit_tpu.parallel.sync_dp import SyncDataParallel  # noqa: F401

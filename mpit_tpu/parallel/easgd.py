@@ -118,7 +118,13 @@ class MeshEASGD:
             "k": jax.device_put(
                 jnp.zeros((self.n_dp,), jnp.int32), self._shardings["k"]
             ),
-            "center": jax.device_put(jnp.asarray(w0), self._shardings["center"]),
+            # Copy w0: device_put may alias the caller's buffer for the
+            # shard landing on the same device, and _sync_jit donates the
+            # center — without the copy the first sync round deletes the
+            # caller's w0.
+            "center": jax.device_put(
+                jnp.array(w0, copy=True), self._shardings["center"]
+            ),
         }
         self._steps = 0
         return state

@@ -1,0 +1,164 @@
+"""Sequence-parallel ring attention over an ICI ring.
+
+The reference has no long-context machinery at all (short per-example QA
+sentences, SURVEY.md §5) — this module is the TPU-native long-context
+capability built on the same collective-permute primitive the PS mesh
+layer uses (:func:`mpit_tpu.parallel.collective.ring_shift`):
+
+- the sequence axis of ``(B, L, H, D)`` activations is sharded over a
+  mesh axis (``sp``): every device holds one contiguous chunk of the
+  sequence and ALL heads — attention memory per device is
+  O(B·(L/n)·H·D) regardless of L;
+- each of the n ring steps computes blockwise attention of the local Q
+  chunk against the KV chunk currently in hand — masked by **global**
+  positions via the q/kv offsets of
+  :func:`mpit_tpu.ops.flash_attention.block_attention_partial` — then
+  passes the KV chunk to the next device with ``ppermute`` (one ICI
+  neighbor hop; XLA overlaps the transfer with the block compute);
+- per-step unnormalized partials ``(acc, m, l)`` are merged with the
+  online-softmax combine (:func:`merge_partials`), so the result is
+  *exactly* full attention, not an approximation.
+
+Two block implementations: ``jnp`` (differentiable end-to-end; XLA fuses
+the blockwise math) and ``pallas`` (the flash kernel emitting partials;
+forward wrapped in a custom VJP whose backward recomputes through the
+jnp ring — per-chunk blockwise memory, no O(L²) materialization).
+
+Causal ring attention computes all n steps on every device (the usual
+non-load-balanced ring; a zigzag layout is a later optimization).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpit_tpu.ops.flash_attention import (
+    block_attention_partial,
+    finalize_partials,
+    flash_attention_partial,
+    merge_partials,
+)
+
+
+def sp_mesh(devices: Sequence[jax.Device] | None = None, axis: str = "sp") -> Mesh:
+    """1-D sequence-parallel mesh over all (or the given) devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devs), (axis,))
+
+
+def _ring_chunks(q, k, v, *, axis, n, partial_fn):
+    """Shared ring loop: local (B, H, C, D) chunks, returns (B, H, C, D).
+
+    ``partial_fn(q, k, v, q_offset, kv_offset) -> (acc, m, l)``.
+    """
+    my = jax.lax.axis_index(axis)
+    chunk = q.shape[-2]
+    q_off = my * chunk
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    acc = jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32)
+    m = jnp.full(q.shape[:-1], float("-inf"), jnp.float32)
+    l = jnp.zeros(q.shape[:-1], jnp.float32)
+
+    kb, vb = k, v
+    for s in range(n):
+        # KV chunk in hand after s hops started at device (my - s).
+        owner = (my + (n - s)) % n
+        part = partial_fn(q, kb, vb, q_off, owner * chunk)
+        acc, m, l = merge_partials((acc, m, l), part)
+        if s + 1 < n:
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+    return finalize_partials(acc, l, dtype=q.dtype)
+
+
+def _ring_jnp(q, k, v, *, axis, n, causal, sm_scale):
+    fn = lambda q2, k2, v2, qo, ko: block_attention_partial(
+        q2, k2, v2, causal=causal, sm_scale=sm_scale, q_offset=qo, kv_offset=ko
+    )
+    return _ring_chunks(q, k, v, axis=axis, n=n, partial_fn=fn)
+
+
+def _ring_pallas(q, k, v, *, axis, n, causal, sm_scale, block_q, block_k,
+                 interpret):
+    fn = lambda q2, k2, v2, qo, ko: flash_attention_partial(
+        q2, k2, v2, causal=causal, sm_scale=sm_scale, q_offset=qo,
+        kv_offset=ko, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _ring_chunks(q, k, v, axis=axis, n=n, partial_fn=fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
+                   interpret):
+    jnp_fn = functools.partial(
+        _ring_jnp, axis=axis, n=n, causal=causal, sm_scale=sm_scale
+    )
+    if impl == "jnp":
+        return jnp_fn
+
+    pallas_fwd = functools.partial(
+        _ring_pallas, axis=axis, n=n, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+    @jax.custom_vjp
+    def fn(q, k, v):
+        return pallas_fwd(q, k, v)
+
+    def fwd(q, k, v):
+        return pallas_fwd(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(jnp_fn, q, k, v)
+        return vjp(g.astype(q.dtype))
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
+def ring_attention(
+    mesh: Mesh,
+    axis: str = "sp",
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    impl: str = "auto",
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]:
+    """Build the sequence-parallel attention fn over ``mesh[axis]``.
+
+    Takes/returns global ``(B, L, H, D)`` arrays with L sharded over
+    ``axis`` (L must divide evenly).  ``impl``: 'jnp', 'pallas', or
+    'auto' (pallas on TPU, jnp elsewhere).  Callable from inside jit.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    if impl not in ("jnp", "pallas"):
+        raise ValueError(f"impl must be auto|jnp|pallas, got {impl!r}")
+    n = mesh.shape[axis]
+    local = _make_local_fn(
+        axis, n, bool(causal), sm_scale, impl, int(block_q), int(block_k),
+        interpret,
+    )
+
+    def _local(q, k, v):
+        # (B, C, H, D) chunk -> heads-major for the block math, and back.
+        qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        return local(qh, kh, vh).transpose(0, 2, 1, 3)
+
+    spec = P(None, axis, None, None)
+    return shard_map(
+        _local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )

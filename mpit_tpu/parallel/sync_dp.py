@@ -58,8 +58,11 @@ class SyncDataParallel:
         )
 
     def init(self, w0: jnp.ndarray) -> Dict[str, Any]:
+        # Copy w0: device_put may alias the caller's buffer on the device
+        # whose shard stays put, and step() donates "w" — without the copy
+        # the first step deletes the caller's w0 out from under them.
         return {
-            "w": jax.device_put(jnp.asarray(w0), self._param_sharding),
+            "w": jax.device_put(jnp.array(w0, copy=True), self._param_sharding),
             "vt": jax.device_put(jnp.zeros_like(w0), self._param_sharding),
             "k": jnp.zeros((), jnp.int32),
         }
