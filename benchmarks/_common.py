@@ -1,0 +1,32 @@
+"""Shared helpers for the benchmark scripts."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup_platform() -> None:
+    """Honor JAX_PLATFORMS even when a preloaded accelerator plugin (the
+    axon TPU tunnel) would otherwise win platform selection — same
+    workaround as tests/conftest.py.  Call before any jax backend use."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def join_checked(threads, timeout: float, what: str) -> None:
+    """Join every thread and fail loudly on a hang — a stalled rank must
+    produce an error, not a bogus bandwidth number."""
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise RuntimeError(f"{what} thread did not finish within {timeout}s")

@@ -1,0 +1,177 @@
+"""PS push/pull bandwidth benchmark — the asyncsgd/ptest.lua analog.
+
+The reference measures bi-directional parameter-server bandwidth: half
+the ranks serve shards of a big flat vector, the rest run T rounds of
+{pull params, push grads, wait} and print ``2*T*ssize*4/elapsed`` MB/s
+(reference asyncsgd/ptest.lua:3,58-67; BASELINE.md config 4).  This
+script measures both rebuild transports:
+
+- **ici** — the on-mesh path: one jitted round = reduce-scatter(grad) +
+  shard apply + all-gather(param) over the ``shard`` axis
+  (:func:`mpit_tpu.parallel.collective.ps_pushpull`), i.e. the traffic
+  pattern the reference drives through MPI, riding ICI instead.
+- **shm** — the host path: ParamClient/ParamServer over the native C++
+  shared-memory transport (servers on their own threads, the C ring
+  releases the GIL), the analog of MPI's shared-memory BTL on one host.
+
+Env knobs: MPIT_BENCH_MB (payload size, default 64), MPIT_BENCH_ROUNDS
+(default 20), MPIT_BENCH_MODE (ici|shm|both, default both),
+MPIT_BENCH_SERVERS / MPIT_BENCH_CLIENTS for the shm topology (default
+2/2, the reference's np=4 split).
+
+Prints one JSON line per mode: MB/s bi-directional, plus per-chip for
+the ici mode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _common import join_checked, log as _log, setup_platform  # noqa: E402
+
+setup_platform()
+
+import numpy as np  # noqa: E402
+
+
+MB = float(os.environ.get("MPIT_BENCH_MB", "64"))
+ROUNDS = int(os.environ.get("MPIT_BENCH_ROUNDS", "20"))
+MODE = os.environ.get("MPIT_BENCH_MODE", "both")
+NSERVERS = int(os.environ.get("MPIT_BENCH_SERVERS", "2"))
+NCLIENTS = int(os.environ.get("MPIT_BENCH_CLIENTS", "2"))
+
+
+def bench_ici() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from mpit_tpu.parallel import make_mesh
+    from mpit_tpu.parallel.collective import ps_pushpull
+    from mpit_tpu.parallel.mesh import param_sharding
+
+    devs = jax.devices()
+    mesh = make_mesh(devs, dp=1)  # all devices on the shard axis
+    n = mesh.shape["shard"]
+    size = int(MB * (1 << 20) / 4 // n * n)
+    _log(f"[ici] {len(devs)} devices, payload {size * 4 / 2**20:.1f} MB")
+
+    roundtrip = jax.jit(ps_pushpull(mesh, lambda p, g: p + g))
+    p_shard = jax.device_put(
+        jnp.zeros((size,), jnp.float32), param_sharding(mesh)
+    )
+    grad = jnp.ones((size,), jnp.float32)
+
+    full, p_shard = roundtrip(p_shard, grad)  # compile + warm
+    jax.block_until_ready(full)
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        full, p_shard = roundtrip(p_shard, grad)
+    jax.block_until_ready(full)
+    dt = time.perf_counter() - t0
+    mbs = 2 * ROUNDS * size * 4 / dt / 2**20  # reference formula
+    _log(f"[ici] {ROUNDS} rounds in {dt:.3f}s -> {mbs:.1f} MB/s "
+         f"({mbs / n:.1f} MB/s/chip)")
+    return {
+        "metric": "ps_pushpull_bandwidth_ici",
+        "value": round(mbs, 1),
+        "unit": "MB/s",
+        "per_chip": round(mbs / n, 1),
+        "devices": n,
+    }
+
+
+def bench_shm() -> dict:
+    from mpit_tpu.comm.shm import ShmTransport
+    from mpit_tpu.ps import ParamClient, ParamServer
+
+    size = int(MB * (1 << 20) / 4)
+    ns = f"ptest_{os.getpid()}"
+    nranks = NSERVERS + NCLIENTS
+    sranks = list(range(NSERVERS))
+    cranks = list(range(NSERVERS, nranks))
+    _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, "
+         f"payload {size * 4 / 2**20:.1f} MB")
+
+    ring = 1 << 24  # 16 MB rings; larger payloads stream in chunks
+    transports = [
+        ShmTransport(ns, r, nranks, ring_bytes=ring) for r in range(nranks)
+    ]
+    servers = [
+        ParamServer(r, cranks, transports[r], rule="add") for r in sranks
+    ]
+    sthreads = [threading.Thread(target=s.start, daemon=True) for s in servers]
+    for t in sthreads:
+        t.start()
+
+    clients = [
+        ParamClient(r, sranks, transports[r], seed_servers=(r == cranks[0]))
+        for r in cranks
+    ]
+    params = [np.zeros(size, np.float32) for _ in cranks]
+    grads = [np.full(size, 1e-6, np.float32) for _ in cranks]
+
+    def client_start(i):
+        clients[i].start(params[i], grads[i])
+
+    starts = [
+        threading.Thread(target=client_start, args=(i,), daemon=True)
+        for i in range(NCLIENTS)
+    ]
+    for t in starts:
+        t.start()
+    join_checked(starts, 60, "[shm] client start")
+
+    def client_rounds(i):
+        c = clients[i]
+        for _ in range(ROUNDS):
+            c.async_recv_param()
+            c.async_send_grad()
+            c.wait()
+
+    workers = [
+        threading.Thread(target=client_rounds, args=(i,), daemon=True)
+        for i in range(NCLIENTS)
+    ]
+    t0 = time.perf_counter()
+    for t in workers:
+        t.start()
+    join_checked(workers, 600, "[shm] client rounds")
+    dt = time.perf_counter() - t0
+
+    for c in clients:
+        c.stop()
+    join_checked(sthreads, 10, "[shm] server stop")
+    for tr in transports:
+        tr.close()
+
+    # Bi-directional bytes moved per client per round = 2 * size * 4.
+    mbs = 2 * ROUNDS * NCLIENTS * size * 4 / dt / 2**20
+    _log(f"[shm] {ROUNDS} rounds x {NCLIENTS} clients in {dt:.3f}s "
+         f"-> {mbs:.1f} MB/s aggregate")
+    return {
+        "metric": "ps_pushpull_bandwidth_shm",
+        "value": round(mbs, 1),
+        "unit": "MB/s",
+        "clients": NCLIENTS,
+        "servers": NSERVERS,
+    }
+
+
+def main():
+    results = []
+    if MODE in ("ici", "both"):
+        results.append(bench_ici())
+    if MODE in ("shm", "both"):
+        results.append(bench_shm())
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Allreduce timing + correctness spot-check — the test/testreduceall.lua
+and test/testireduceall.lua analog.
+
+The reference times a blocking Allreduce of MEGS*2^20 floats (env-sized,
+test/testreduceall.lua:8-9,31-33) and a nonblocking Iallreduce with
+Test-before/after-Wait (test/testireduceall.lua:32-39), plus a seeded
+correctness print (asyncsgd/testreduceall.lua:72-77).  TPU-native:
+
+- blocking analog — jitted ``psum`` over every device (shard_map), timed
+  with ``block_until_ready`` per round;
+- nonblocking analog — the same op dispatched ROUNDS times *ahead*
+  before a single block (XLA's async dispatch is the Iallreduce: the
+  host thread runs free while collectives execute);
+- correctness — the psum of seeded per-device uniforms must equal the
+  numpy sum of the same stacked array.
+
+Env knobs: MEGS (payload in MB, default 8 — same env var name as the
+reference), MPIT_BENCH_ROUNDS (default 20).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _common import join_checked, log as _log, setup_platform  # noqa: E402
+
+setup_platform()
+
+import numpy as np  # noqa: E402
+
+
+MEGS = float(os.environ.get("MEGS", "8"))
+ROUNDS = int(os.environ.get("MPIT_BENCH_ROUNDS", "20"))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("x",))
+    size = int(MEGS * (1 << 20) / 4 // n * n)
+    _log(f"{n} devices, {size * 4 / 2**20:.1f} MB per-device payload")
+
+    allreduce = jax.jit(
+        shard_map(
+            lambda x: jax.lax.psum(x, "x"), mesh=mesh,
+            in_specs=P("x"), out_specs=P("x"), check_vma=False,
+        )
+    )
+
+    rng = np.random.default_rng(0)
+    stacked = rng.uniform(size=(n, size)).astype(np.float32)
+    x = jax.device_put(
+        jnp.asarray(stacked.reshape(n * size)),
+        NamedSharding(mesh, P("x")),
+    )
+
+    # Correctness spot-check (the seeded-uniform print of
+    # asyncsgd/testreduceall.lua:72-77, with an actual assertion).
+    out = np.asarray(allreduce(x))
+    expect = stacked.sum(axis=0)
+    np.testing.assert_allclose(out[:size], expect, rtol=1e-4)
+    _log("correctness: psum == stacked numpy sum")
+
+    # Blocking rounds.
+    jax.block_until_ready(allreduce(x))
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        jax.block_until_ready(allreduce(x))
+    dt_block = time.perf_counter() - t0
+
+    # Nonblocking: dispatch every round ahead, block once at the end.
+    t0 = time.perf_counter()
+    ys = [allreduce(x) for _ in range(ROUNDS)]
+    dt_dispatch = time.perf_counter() - t0
+    jax.block_until_ready(ys)
+    dt_async = time.perf_counter() - t0
+
+    per_round_ms = dt_block / ROUNDS * 1e3
+    _log(f"blocking: {per_round_ms:.2f} ms/round; async total "
+         f"{dt_async / ROUNDS * 1e3:.2f} ms/round "
+         f"(dispatch {dt_dispatch * 1e3:.1f} ms for {ROUNDS})")
+    print(json.dumps({
+        "metric": "allreduce_ms_per_round",
+        "value": round(per_round_ms, 3),
+        "unit": "ms",
+        "async_ms_per_round": round(dt_async / ROUNDS * 1e3, 3),
+        "payload_mb": round(size * 4 / 2**20, 1),
+        "devices": n,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,7 @@ import dataclasses
 import json
 import os
 import pathlib
+import warnings
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -311,7 +312,17 @@ def load_binary(
         ragged = json.loads(bytes(z["ragged"]).decode())
         cached_cw = ragged.get("conv_width")  # None: pre-recording cache
         cached_dim = int(ragged["embedding_dim"])
-        if (expect_conv_width is not None and cached_cw is not None
+        if expect_conv_width is not None and cached_cw is None:
+            # Legacy cache without the recorded width: loadable, but the
+            # mismatch check can't run — say so instead of failing or
+            # staying silent.
+            warnings.warn(
+                f"binary cache {path} predates conv_width recording; cannot "
+                f"verify it matches conv_width={expect_conv_width} — rebuild "
+                "the cache to silence this",
+                stacklevel=2,
+            )
+        elif (expect_conv_width is not None
                 and int(cached_cw) != expect_conv_width):
             raise ValueError(
                 f"binary cache {path} was built with conv_width={cached_cw}, "

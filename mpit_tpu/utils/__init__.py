@@ -1,6 +1,6 @@
 """Shared utilities: typed config, phase timers, logging, serialization."""
 
 from mpit_tpu.utils.config import Config
-from mpit_tpu.utils.timers import PhaseTimers
+from mpit_tpu.utils.timers import PhaseTimers, profiler_trace, trace_annotation
 
-__all__ = ["Config", "PhaseTimers"]
+__all__ = ["Config", "PhaseTimers", "profiler_trace", "trace_annotation"]

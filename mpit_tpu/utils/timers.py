@@ -61,3 +61,23 @@ def trace_annotation(name: str) -> Iterator[None]:
         annotation = contextlib.nullcontext()
     with annotation:
         yield
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str | None) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``log_dir`` (view with
+    TensorBoard / xprof) around the enclosed block; no-op when
+    ``log_dir`` is falsy.  The deep-trace companion to
+    :class:`PhaseTimers` — trainers accept a ``profile_dir`` config knob
+    and wrap their hot loop with this (the rebuild's answer to the
+    reference's print-only timing, SURVEY.md §5 tracing)."""
+    if not log_dir:
+        yield
+        return
+    import jax.profiler as _prof
+
+    _prof.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        _prof.stop_trace()
