@@ -37,8 +37,6 @@ from _common import join_checked, log as _log, setup_platform, shm_gang  # noqa:
 
 setup_platform()
 
-import numpy as np  # noqa: E402
-
 
 MB = float(os.environ.get("MPIT_BENCH_MB", "64"))
 ROUNDS = int(os.environ.get("MPIT_BENCH_ROUNDS", "20"))

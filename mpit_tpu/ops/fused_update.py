@@ -29,9 +29,28 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import os
+
 from mpit_tpu.ops.tiles import (
     LANE, as_rows, block_rows_for, from_rows, use_interpret as _interpret,
 )
+
+
+def fused_enabled(flag: bool | None = None) -> bool:
+    """Should a caller route through the fused kernels?  Resolution:
+    explicit flag > MPIT_FUSED env (``1``/``0``) > on-TPU default.
+    An explicit flag wins over the env because call sites use False as a
+    hard constraint (the mesh trainers force it off inside sharded jits,
+    where a pallas call can't be auto-partitioned); the env is a
+    preference for the unconstrained (None) sites.  Off-TPU the kernels
+    run interpreted — correct but slower than XLA's own fusion, hence
+    the default."""
+    if flag is not None:
+        return bool(flag)
+    env = os.environ.get("MPIT_FUSED")
+    if env in ("1", "0"):
+        return env == "1"
+    return jax.default_backend() == "tpu"
 
 
 def _scalar(x, dtype) -> jnp.ndarray:

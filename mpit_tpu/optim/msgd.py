@@ -25,6 +25,8 @@ from typing import Any, Callable, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from mpit_tpu.ops.fused_update import fused_enabled as _fused_enabled
+
 
 class MSGDConfig(NamedTuple):
     lr: float = 0.0
@@ -37,6 +39,7 @@ class MSGDConfig(NamedTuple):
     # Reference msgd enables decay only when lrd>0 AND lrp>0
     # (optim-msgd.lua:33); eamsgd's embedded copy uses lrd!=0 AND lrp>0
     # (optim-eamsgd.lua:40) — identical for the sane lrd>=0 regime.
+    use_fused: bool | None = None  # pallas commit sweep (on-TPU default)
 
 
 def msgd_init(w: Any) -> dict:
@@ -73,10 +76,26 @@ def msgd_lookahead(w: Any, state: dict, cfg: MSGDConfig) -> Tuple[Any, dict]:
 
 
 def msgd_commit(w: Any, grad: Any, state: dict, cfg: MSGDConfig) -> Tuple[Any, dict]:
-    """Phase 2: weight-decay, decayed-lr descent, velocity update (:31-40)."""
+    """Phase 2: weight-decay, decayed-lr descent, velocity update (:31-40).
+
+    Flat 1-D params with momentum take the fused pallas sweep
+    (:func:`mpit_tpu.ops.fused_update.fused_nesterov_commit`) when enabled
+    — one HBM read/write of (w, vt, g) instead of several."""
+    clr = _effective_lr(cfg, state["k"])
+    if (
+        cfg.mom > 0
+        and isinstance(w, jnp.ndarray)
+        and w.ndim == 1
+        and _fused_enabled(cfg.use_fused)
+    ):
+        from mpit_tpu.ops.fused_update import fused_nesterov_commit
+
+        w_new, vt = fused_nesterov_commit(
+            w, state["vt"], grad, clr, l2wd=float(cfg.l2wd)
+        )
+        return w_new, {"k": state["k"] + 1, "vt": vt}
     if cfg.l2wd != 0:
         grad = jax.tree_util.tree_map(lambda g, p: g + cfg.l2wd * p, grad, w)
-    clr = _effective_lr(cfg, state["k"])
     w = jax.tree_util.tree_map(lambda p, g: p - clr * g, w, grad)
     vt = state["vt"]
     if cfg.mom > 0:

@@ -111,15 +111,18 @@ def adam_apply(
     beta2: float = 0.999,
     epsilon: float = 1e-8,
     step_div: int | None = None,
+    use_fused: bool | None = None,
 ) -> Tuple[jnp.ndarray, State]:
     """``step_div`` set -> server-mode bias correction with exponent
     ``floor(t/step_div)+1`` (reference :151-153 — dampens the correction when
     many async clients drive ``t``); None -> plain exponent ``t``
-    (single-worker mode, reference optim-adam-single.lua:28-30)."""
+    (single-worker mode, reference optim-adam-single.lua:28-30).
+
+    ``use_fused`` routes the element-wise sweep through the pallas kernel
+    (:func:`mpit_tpu.ops.fused_update.fused_adam` — one HBM pass, donated
+    buffers); default on on TPU, off elsewhere.  The scalar bias
+    correction stays here either way."""
     t = state["t"] + 1
-    m = beta1 * state["m"] + (1.0 - beta1) * g
-    v = beta2 * state["v"] + (1.0 - beta2) * g * g
-    d = jnp.sqrt(v) + epsilon
     if step_div is None:
         exponent = t.astype(p.dtype)
     else:
@@ -127,6 +130,18 @@ def adam_apply(
     beta1_t = 1.0 - jnp.power(jnp.asarray(beta1, p.dtype), exponent)
     beta2_t = 1.0 - jnp.power(jnp.asarray(beta2, p.dtype), exponent)
     lr_t = lr * jnp.sqrt(beta2_t) / beta1_t
+
+    from mpit_tpu.ops.fused_update import fused_adam, fused_enabled
+
+    if p.ndim == 1 and fused_enabled(use_fused):
+        p_new, m, v = fused_adam(
+            p, g, state["m"], state["v"], lr_t,
+            beta1=beta1, beta2=beta2, epsilon=epsilon,
+        )
+        return p_new, {"t": t, "m": m, "v": v}
+    m = beta1 * state["m"] + (1.0 - beta1) * g
+    v = beta2 * state["v"] + (1.0 - beta2) * g * g
+    d = jnp.sqrt(v) + epsilon
     return p - lr_t * m / d, {"t": t, "m": m, "v": v}
 
 

@@ -125,8 +125,9 @@ def bootstrap(
         num_processes = num_processes if num_processes is not None else hf_n
 
     if coordinator is None and num_processes is None and process_id is None:
-        # Single-host (or externally-initialized) run: nothing to do.
-        return ProcessGroup(0, 1, None)
+        # Single-host run, or a cloud TPU pod whose runtime auto-initialized
+        # the group from metadata — report the real identity either way.
+        return ProcessGroup(jax.process_index(), jax.process_count(), None)
 
     num_processes = 1 if num_processes is None else num_processes
     if process_id is None:

@@ -58,6 +58,11 @@ class MeshEASGD:
         if not (su > 0 and mva > 0):
             raise ValueError("easgd requires su>0 and mva>0 (reference :86)")
         self.mesh = mesh
+        # Force the plain-XLA commit: inside this sharded jit a pallas
+        # call can't be auto-partitioned over the mesh (the fused sweep is
+        # for single-device flat vectors; here XLA fuses the update into
+        # the program anyway).
+        cfg = cfg._replace(use_fused=False)
         self.cfg = cfg
         self.mva = float(mva)
         self.su = int(su)

@@ -37,6 +37,9 @@ class SyncDataParallel:
         cfg: MSGDConfig,
     ):
         self.mesh = mesh
+        # Plain-XLA commit: a pallas call can't be auto-partitioned over
+        # the mesh inside this sharded jit (see easgd.py).
+        cfg = cfg._replace(use_fused=False)
         self.cfg = cfg
         ps = NamedSharding(mesh, P("shard"))  # 1-D param/state sharding
         bs = NamedSharding(mesh, P("dp"))     # batch rows over workers

@@ -67,6 +67,7 @@ class ParamServer:
         self._apply = jax.jit(self.rule.apply)
         self.grads_applied = 0
         self.params_served = 0
+        self._restored = False
 
     # -- service generators (reference pserver.lua coroutines) --------------
 
@@ -154,6 +155,44 @@ class ParamServer:
         if self._stopped_clients == len(self.cranks):
             self.live.stop()
 
+    # -- checkpoint / resume (beyond-reference: SURVEY §5 notes server
+    # state is never checkpointed there; here Adam/RMSProp moments
+    # survive a restart) --------------------------------------------------
+
+    def save_state(self, directory) -> "str":
+        """Checkpoint this server's shard param + rule state.  Call from
+        the owning thread while no grad is mid-apply (e.g. after start()
+        returns, or from a service hook between applies)."""
+        from mpit_tpu.utils.checkpoint import save_server_state
+
+        if self.param is None:
+            raise RuntimeError("server holds no shard yet (init not run)")
+        return str(save_server_state(
+            directory, self.rank, self.offset, self.size,
+            np.asarray(self.param),
+            {k: np.asarray(v) for k, v in (self.rule_state or {}).items()},
+            meta={"grads_applied": self.grads_applied},
+        ))
+
+    def restore_state(self, path) -> None:
+        """Load a shard checkpoint before start().  A restored server
+        skips the client-seeding phase — start the clients with
+        ``seed_servers=False`` (the resume flow; reference resume instead
+        reloads params on the client and reseeds, plaunch.lua:62)."""
+        from mpit_tpu.utils.checkpoint import load_server_state
+
+        if self.param is not None or self.offset != -1:
+            raise RuntimeError("restore_state must run before start()")
+        offset, size, param, state, _meta = load_server_state(path)
+        self.offset, self.size = offset, size
+        self.param = jnp.asarray(param)
+        if state:
+            self.rule_state = {k: jnp.asarray(v) for k, v in state.items()}
+        else:  # stateless rule (plain add) or legacy checkpoint
+            self.rule_state = self.rule.init(self.param)
+        self._param_staging = np.zeros((size,), dtype=self.dtype)
+        self._restored = True
+
     # -- orchestration (reference pserver.lua:131-157) ----------------------
 
     def start(self) -> None:
@@ -163,10 +202,12 @@ class ParamServer:
             self.sched.spawn(self._recv_init(crank), name=f"recv_init:{crank}")
         self.sched.wait()
         # Phase 2: parameter seeding from the first client only
-        # (init once & only once, reference README:64-67).
-        seeder = self.cranks[0]
-        self.sched.spawn(self._recv_param(seeder, once=True), name="seed_param")
-        self.sched.wait()
+        # (init once & only once, reference README:64-67) — skipped on
+        # resume, where the checkpoint already seeded the shard.
+        if not self._restored:
+            seeder = self.cranks[0]
+            self.sched.spawn(self._recv_param(seeder, once=True), name="seed_param")
+            self.sched.wait()
         # Phase 3: perpetual services per client + stop counters.
         for crank in self.cranks:
             self.sched.spawn(self._recv_stop(crank), name=f"recv_stop:{crank}")

@@ -95,8 +95,10 @@ def run(cfg: Config) -> dict:
     models = {"linear": MnistLinear, "mlp": MnistMLP}
     if cfg.model == "cnn":
         module = MnistCNN(side=cfg.side, num_classes=10)
-    else:
+    elif cfg.model in models:
         module = models[cfg.model](num_classes=10)
+    else:
+        raise ValueError(f"model must be linear|mlp|cnn, got {cfg.model!r}")
     flat = flatten_module(
         module, jax.random.PRNGKey(cfg.seed), jnp.asarray(x_train[:2], dtype)
     )

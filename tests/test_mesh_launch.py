@@ -14,12 +14,6 @@ def _tiny_cfg(**kw):
     return MESH_LAUNCH_DEFAULTS.merged(base)
 
 
-@pytest.fixture(scope="module")
-def digits_data(tmp_path_factory):
-    # load_mnist falls back to its offline source internally; nothing to do.
-    return None
-
-
 def test_easgd_trains():
     res = run(_tiny_cfg(opt="easgd", su=2, mva=0.2, lr=0.1, mom=0.9))
     assert len(res["history"]) == 2

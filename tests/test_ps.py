@@ -242,3 +242,70 @@ class TestPSWithOptimizers:
             join_all(threads)
             np.testing.assert_allclose(
                 np.asarray(servers[0].param), np.asarray(w), rtol=1e-5)
+
+
+class TestServerCheckpointResume:
+    def test_adam_resume_matches_uninterrupted(self, rng, tmp_path):
+        """Save server shard state mid-training, restart the topology from
+        the checkpoint, finish — result must match a never-interrupted
+        rollout (moments included; the reference loses these, SURVEY §5)."""
+        w0 = rng.normal(size=10).astype(np.float32)
+        grads = [rng.normal(size=10).astype(np.float32) for _ in range(4)]
+        hp = dict(lr=1e-2, beta1=0.9, beta2=0.999, epsilon=1e-8)
+
+        # Session 1: seed + 2 grads, checkpoint both servers, stop.
+        paths = []
+        with launch(2, 1, rule=rules.make("adam", **hp)) as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+            for g in grads[:2]:
+                grad[:] = g
+                client.async_send_grad()
+                client.wait()
+            client.stop()
+            join_all(threads)
+            paths = [s.save_state(tmp_path) for s in servers]
+
+        # Session 2: restore servers, client joins WITHOUT seeding, 2 more
+        # grads, pull final params.
+        router = __import__("mpit_tpu.comm.local", fromlist=["LocalRouter"]).LocalRouter(3)
+        servers2 = [
+            ParamServer(r, [2], router.endpoint(r), rule=rules.make("adam", **hp))
+            for r in (0, 1)
+        ]
+        for s, p in zip(servers2, paths):
+            s.restore_state(p)
+        threads2 = [threading.Thread(target=s.start, daemon=True) for s in servers2]
+        for t in threads2:
+            t.start()
+        client2 = ParamClient(2, [0, 1], router.endpoint(2), seed_servers=False)
+        param2, grad2 = np.zeros_like(w0), np.zeros_like(w0)
+        client2.start(param2, grad2)
+        for g in grads[2:]:
+            grad2[:] = g
+            client2.async_send_grad()
+            client2.wait()
+        client2.async_recv_param()
+        client2.wait()
+        client2.stop()
+        join_all(threads2)
+
+        # Uninterrupted reference rollout.
+        rule = rules.make("adam", **hp)
+        p = jnp.asarray(w0)
+        st = rule.init(p)
+        for g in grads:
+            p, st = rule.apply(p, jnp.asarray(g), st)
+        np.testing.assert_allclose(param2, np.asarray(p), rtol=1e-6, atol=1e-7)
+
+    def test_restore_after_init_rejected(self, rng, tmp_path):
+        w0 = rng.normal(size=6).astype(np.float32)
+        with launch(1, 1) as (servers, (client,), threads):
+            client.start(w0.copy(), np.zeros_like(w0))
+            path = None
+            with pytest.raises(RuntimeError):
+                servers[0].restore_state(tmp_path / "nope.npz")
+            path = servers[0].save_state(tmp_path)
+            client.stop()
+            join_all(threads)
+        assert path and "server0" in path
