@@ -48,8 +48,15 @@ def fused_enabled(flag: bool | None = None) -> bool:
     if flag is not None:
         return bool(flag)
     env = os.environ.get("MPIT_FUSED")
-    if env in ("1", "0"):
-        return env == "1"
+    if env is not None:
+        norm = env.strip().lower()
+        if norm in ("1", "true", "on", "yes"):
+            return True
+        if norm in ("0", "false", "off", "no", ""):
+            return False
+        raise ValueError(
+            f"MPIT_FUSED={env!r} not understood; use 1/0 (or true/false)"
+        )
     return jax.default_backend() == "tpu"
 
 

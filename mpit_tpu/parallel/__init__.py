@@ -28,6 +28,15 @@ from mpit_tpu.parallel.distributed import (  # noqa: F401
     read_hostfile,
 )
 from mpit_tpu.parallel.easgd import MeshEASGD  # noqa: F401
+from mpit_tpu.parallel.moe import ep_moe, moe_reference  # noqa: F401
+from mpit_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline,
+    stack_stage_params,
+)
+from mpit_tpu.parallel.tensor_parallel import (  # noqa: F401
+    tp_mlp,
+    tp_self_attention,
+)
 from mpit_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     sp_mesh,

@@ -90,7 +90,8 @@ class ParamServer:
             )
         self.grad_bufs[crank] = np.zeros((size,), dtype=self.dtype)
 
-    def _recv_param(self, crank: int, once: bool = True):
+    def _recv_param(self, crank: int, once: bool = True,
+                    warn_unexpected: bool = False):
         """Whole-shard write from a client: one-shot seeding from the first
         client (reference :92-102) or perpetual in single mode (the
         BiCNN recvparam_always service, BiCNN/pserver.lua:220-232)."""
@@ -101,6 +102,12 @@ class ParamServer:
             )
             if got is None:
                 return
+            if warn_unexpected:
+                self.log.warning(
+                    "client %d seeded a RESTORED server: checkpointed "
+                    "params overwritten (optimizer state kept) — start "
+                    "resume clients with seed_servers=False", crank,
+                )
             self.param = jnp.asarray(self._param_staging)
             yield from aio_send(
                 self.transport, tags.EMPTY, crank, tags.PARAM_PUSH_ACK, live=self.live
@@ -183,8 +190,9 @@ class ParamServer:
 
         if self.param is not None or self.offset != -1:
             raise RuntimeError("restore_state must run before start()")
-        offset, size, param, state, _meta = load_server_state(path)
+        offset, size, param, state, meta = load_server_state(path)
         self.offset, self.size = offset, size
+        self.grads_applied = int(meta.get("grads_applied", 0))
         self.param = jnp.asarray(param)
         if state:
             self.rule_state = {k: jnp.asarray(v) for k, v in state.items()}
@@ -204,11 +212,20 @@ class ParamServer:
         # Phase 2: parameter seeding from the first client only
         # (init once & only once, reference README:64-67) — skipped on
         # resume, where the checkpoint already seeded the shard.
+        seeder = self.cranks[0]
         if not self._restored:
-            seeder = self.cranks[0]
             self.sched.spawn(self._recv_param(seeder, once=True), name="seed_param")
             self.sched.wait()
         # Phase 3: perpetual services per client + stop counters.
+        if self._restored and not self.single_mode:
+            # A resume client wired with seed_servers=True would otherwise
+            # block forever on its unconsumed push — accept it (client is
+            # authoritative for params, as in the reference's -loadmodel
+            # reseed, plaunch.lua:62) and warn loudly.
+            self.sched.spawn(
+                self._recv_param(seeder, once=True, warn_unexpected=True),
+                name="unexpected_seed",
+            )
         for crank in self.cranks:
             self.sched.spawn(self._recv_stop(crank), name=f"recv_stop:{crank}")
             self.sched.spawn(self._recv_grad(crank), name=f"recv_grad:{crank}")

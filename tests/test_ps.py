@@ -309,3 +309,34 @@ class TestServerCheckpointResume:
             client.stop()
             join_all(threads)
         assert path and "server0" in path
+
+    def test_resume_with_seeding_client_warns_not_hangs(self, rng, tmp_path):
+        """A resume client mistakenly wired with seed_servers=True must not
+        deadlock: the restored server consumes+acks the push (client
+        authoritative for params, optimizer state kept)."""
+        w0 = rng.normal(size=8).astype(np.float32)
+        with launch(1, 1, rule=rules.make("adam")) as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+            grad[:] = 1.0
+            client.async_send_grad()
+            client.wait()
+            client.stop()
+            join_all(threads)
+            path = servers[0].save_state(tmp_path)
+
+        router = __import__("mpit_tpu.comm.local", fromlist=["LocalRouter"]).LocalRouter(2)
+        server2 = ParamServer(0, [1], router.endpoint(0), rule=rules.make("adam"))
+        server2.restore_state(path)
+        t = threading.Thread(target=server2.start, daemon=True)
+        t.start()
+        client2 = ParamClient(1, [0], router.endpoint(1), seed_servers=True)
+        fresh = rng.normal(size=8).astype(np.float32)
+        param2, grad2 = fresh.copy(), np.zeros_like(w0)
+        client2.start(param2, grad2)  # would hang before the guard
+        client2.async_recv_param()
+        client2.wait()
+        np.testing.assert_allclose(param2, fresh, rtol=1e-6)  # client's seed won
+        assert server2.grads_applied == 1  # counter restored from meta
+        client2.stop()
+        join_all([t])
