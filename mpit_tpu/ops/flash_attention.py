@@ -28,6 +28,7 @@ the ring layout is per-chunk, i.e. already blockwise).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 from typing import Tuple
@@ -136,7 +137,7 @@ def finalize_partials(acc, l, dtype=jnp.float32):
 
 
 def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
-               *rest, causal, scale, block_q, block_k, partial):
+               *rest, causal, scale, block_q, block_k, partial, precision):
     if partial:
         m_out, l_out, acc_scr, m_scr, l_scr = rest
     else:
@@ -165,7 +166,8 @@ def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
         qf = q_ref[:].astype(jnp.float32)
         kf = k_ref[:].astype(jnp.float32)
         s = jax.lax.dot_general(
-            qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            qf, kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
         ) * scale  # (block_q, block_k)
 
         qi = (qoff_ref[0, 0] + i * block_q
@@ -184,7 +186,7 @@ def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p, v_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
         acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -204,7 +206,7 @@ def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
-           block_k, interpret, partial=False):
+           block_k, interpret, partial=False, precision=None):
     """Core call on (Lq, D) x (Lk, D); pads to tiles.  Returns the
     normalized (Lq, D) output, or with ``partial`` the unnormalized
     ``(acc, m, l)`` triple (f32) for cross-chunk merging."""
@@ -235,7 +237,7 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
     res = pl.pallas_call(
         functools.partial(
             _fa_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk,
-            partial=partial,
+            partial=partial, precision=precision,
         ),
         grid=grid,
         in_specs=[
@@ -275,6 +277,7 @@ def flash_attention_partial(
     block_q: int = 256,
     block_k: int = 512,
     interpret: bool | None = None,
+    precision: str | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Pallas twin of :func:`block_attention_partial`: unnormalized
     ``(acc, m, l)`` over ``(..., L, D)``.  Forward-only — ring attention
@@ -283,6 +286,7 @@ def flash_attention_partial(
     f = lambda q2, k2, v2: _fa_2d(
         q2, k2, v2, q_offset, kv_offset, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, interpret=interpret, partial=True,
+        precision=precision,
     )
     for _ in range(q.ndim - 2):
         f = jax.vmap(f)
@@ -290,7 +294,7 @@ def flash_attention_partial(
 
 
 @functools.lru_cache(maxsize=None)
-def _make_flash(causal, sm_scale, block_q, block_k, interpret):
+def _make_flash(causal, sm_scale, block_q, block_k, interpret, precision):
     """Differentiable flash op for fixed static config: pallas forward,
     recompute-backward through the jnp reference."""
 
@@ -299,7 +303,7 @@ def _make_flash(causal, sm_scale, block_q, block_k, interpret):
         f = lambda q2, k2, v2: _fa_2d(
             q2, k2, v2, q_offset, kv_offset, causal=causal,
             sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            interpret=interpret,
+            interpret=interpret, precision=precision,
         )
         for _ in range(q.ndim - 2):
             f = jax.vmap(f)
@@ -314,8 +318,13 @@ def _make_flash(causal, sm_scale, block_q, block_k, interpret):
             attention_reference, causal=causal, sm_scale=sm_scale,
             q_offset=q_offset, kv_offset=kv_offset,
         )
-        _, vjp = jax.vjp(ref, q, k, v)
-        dq, dk, dv = vjp(g.astype(q.dtype))
+        # Match the forward's matmul precision in the recompute so the
+        # knob governs both directions.
+        ctx = (jax.default_matmul_precision(precision) if precision
+               else contextlib.nullcontext())
+        with ctx:
+            _, vjp = jax.vjp(ref, q, k, v)
+            dq, dk, dv = vjp(g.astype(q.dtype))
         return dq, dk, dv, None, None
 
     fa.defvjp(fwd, bwd)
@@ -334,10 +343,15 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 512,
     interpret: bool | None = None,
+    precision: str | None = None,
 ) -> jnp.ndarray:
     """Flash attention over ``(..., L, D)`` with global-offset causal
-    masking.  Leading axes are batched (vmapped); offsets may be traced."""
+    masking.  Leading axes are batched (vmapped); offsets may be traced.
+
+    ``precision``: MXU input precision for the two block matmuls (e.g.
+    ``"highest"`` for full-f32 inputs); None uses the backend default —
+    bf16 MXU passes on TPU, the standard flash-attention trade."""
     fa = _make_flash(bool(causal), sm_scale, int(block_q), int(block_k),
-                     _interpret(interpret))
+                     _interpret(interpret), precision)
     return fa(q, k, v, jnp.asarray(q_offset, jnp.int32),
               jnp.asarray(kv_offset, jnp.int32))

@@ -87,17 +87,18 @@ def _ring_jnp(q, k, v, *, axis, n, causal, sm_scale):
 
 
 def _ring_pallas(q, k, v, *, axis, n, causal, sm_scale, block_q, block_k,
-                 interpret):
+                 interpret, precision):
     fn = lambda q2, k2, v2, qo, ko: flash_attention_partial(
         q2, k2, v2, causal=causal, sm_scale=sm_scale, q_offset=qo,
         kv_offset=ko, block_q=block_q, block_k=block_k, interpret=interpret,
+        precision=precision,
     )
     return _ring_chunks(q, k, v, axis=axis, n=n, partial_fn=fn)
 
 
 @functools.lru_cache(maxsize=None)
 def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
-                   interpret):
+                   interpret, precision):
     jnp_fn = functools.partial(
         _ring_jnp, axis=axis, n=n, causal=causal, sm_scale=sm_scale
     )
@@ -107,6 +108,7 @@ def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
     pallas_fwd = functools.partial(
         _ring_pallas, axis=axis, n=n, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        precision=precision,
     )
 
     @jax.custom_vjp
@@ -135,6 +137,7 @@ def ring_attention(
     block_q: int = 256,
     block_k: int = 512,
     interpret: bool | None = None,
+    precision: str | None = None,
 ) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]:
     """Build the sequence-parallel attention fn over ``mesh[axis]``.
 
@@ -149,7 +152,7 @@ def ring_attention(
     n = mesh.shape[axis]
     local = _make_local_fn(
         axis, n, bool(causal), sm_scale, impl, int(block_q), int(block_k),
-        interpret,
+        interpret, precision,
     )
 
     def _local(q, k, v):
