@@ -9,14 +9,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def setup_platform() -> None:
-    """Honor JAX_PLATFORMS even when a preloaded accelerator plugin (the
-    axon TPU tunnel) would otherwise win platform selection — same
-    workaround as tests/conftest.py.  Call before any jax backend use."""
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
+    """Honor JAX_PLATFORMS even when a preloaded accelerator plugin would
+    otherwise win platform selection.  Call before any jax backend use."""
+    from mpit_tpu.utils.platform import honor_jax_platforms
 
-        jax.config.update("jax_platforms", plat)
+    honor_jax_platforms()
 
 
 def log(*a) -> None:

@@ -30,6 +30,7 @@ non-load-balanced ring; a zigzag layout is a later optimization).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Callable, Sequence
 
@@ -79,11 +80,17 @@ def _ring_chunks(q, k, v, *, axis, n, partial_fn):
     return finalize_partials(acc, l, dtype=q.dtype)
 
 
-def _ring_jnp(q, k, v, *, axis, n, causal, sm_scale):
+def _precision_ctx(precision):
+    return (jax.default_matmul_precision(precision) if precision
+            else contextlib.nullcontext())
+
+
+def _ring_jnp(q, k, v, *, axis, n, causal, sm_scale, precision=None):
     fn = lambda q2, k2, v2, qo, ko: block_attention_partial(
         q2, k2, v2, causal=causal, sm_scale=sm_scale, q_offset=qo, kv_offset=ko
     )
-    return _ring_chunks(q, k, v, axis=axis, n=n, partial_fn=fn)
+    with _precision_ctx(precision):
+        return _ring_chunks(q, k, v, axis=axis, n=n, partial_fn=fn)
 
 
 def _ring_pallas(q, k, v, *, axis, n, causal, sm_scale, block_q, block_k,
@@ -100,7 +107,8 @@ def _ring_pallas(q, k, v, *, axis, n, causal, sm_scale, block_q, block_k,
 def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
                    interpret, precision):
     jnp_fn = functools.partial(
-        _ring_jnp, axis=axis, n=n, causal=causal, sm_scale=sm_scale
+        _ring_jnp, axis=axis, n=n, causal=causal, sm_scale=sm_scale,
+        precision=precision,
     )
     if impl == "jnp":
         return jnp_fn
@@ -120,6 +128,8 @@ def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
 
     def bwd(res, g):
         q, k, v = res
+        # jnp_fn already carries the precision context, so the recompute
+        # matches the forward's matmul precision.
         _, vjp = jax.vjp(jnp_fn, q, k, v)
         return vjp(g.astype(q.dtype))
 

@@ -31,9 +31,15 @@ def child_transport(cfg: Config, rank: int, size: int):
 
 
 def launch_gang(
-    child_module: str, cfg: Config, timeout: float = 3600.0
+    child_module: str, cfg: Config, timeout: float = 3600.0,
+    env_overrides: Optional[Dict[int, Dict[str, str]]] = None,
 ) -> Dict[int, Dict[str, Any]]:
-    """Spawn ``python -m <child_module> --child`` per rank; gang-monitor."""
+    """Spawn ``python -m <child_module> --child`` per rank; gang-monitor.
+
+    ``env_overrides`` maps rank -> extra env vars for that child — the
+    device-assignment hook (the reference's per-rank GPU map,
+    mlaunch.lua:56-62, expressed as per-rank platform/visible-device
+    env)."""
     size = int(cfg.np)
     namespace = cfg.get("namespace") or f"mpit{os.getpid()}"
     cfg = cfg.merged(namespace=namespace)
@@ -53,6 +59,7 @@ def launch_gang(
         logfiles.append(logpath)
         resultfiles.append(resultpath)
         env = {**env_base, "MPIT_RANK": str(rank), "MPIT_RESULT_FILE": resultpath}
+        env.update((env_overrides or {}).get(rank, {}))
         with open(logpath, "w") as fh:
             procs.append(
                 subprocess.Popen(
@@ -117,7 +124,14 @@ def launch_gang(
 
 
 def child_env() -> tuple[int, int, Config]:
-    """(rank, size, cfg) from the gang environment, for ``--child`` mains."""
+    """(rank, size, cfg) from the gang environment, for ``--child`` mains.
+
+    Also applies the child's JAX_PLATFORMS assignment — a preloaded
+    accelerator plugin would otherwise override the env var and every
+    rank would contend for the same chip."""
+    from mpit_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
     rank = int(os.environ["MPIT_RANK"])
     size = int(os.environ["MPIT_SIZE"])
     cfg = Config(**json.loads(os.environ["MPIT_CFG"]))

@@ -43,6 +43,10 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     ckpt_dir="",
     ring_mb=64,
     namespace="",
+    # Per-rank device assignment (the reference's AGPU map,
+    # mlaunch.lua:56-62): inherit | cpu | workers_accel (one compute rank
+    # — tester else first client — owns the accelerator, rest CPU).
+    device_policy="inherit",
 )
 
 
@@ -131,7 +135,35 @@ def _child_main() -> None:
     transport = child_transport(cfg, rank, size)
     result = run_rank(rank, size, cfg, transport)
     transport.close()
+    import jax
+
+    result.setdefault("platform", jax.default_backend())
     write_result(result)
+
+
+def device_env_overrides(cfg: Config, size: int) -> Dict[int, Dict[str, str]]:
+    """Per-rank JAX_PLATFORMS assignment from cfg.device_policy."""
+    policy = cfg.get("device_policy", "inherit")
+    if policy == "inherit":
+        return {}
+    if policy == "cpu":
+        return {r: {"JAX_PLATFORMS": "cpu"} for r in range(size)}
+    if policy == "workers_accel":
+        # Single-accelerator hosts: exactly ONE rank may own the chip
+        # (libtpu holds an exclusive lock) — the tester if present, else
+        # the first client; every other rank is forced to CPU.  Multi-chip
+        # hosts should pass per-rank visible-device env via launch_gang's
+        # env_overrides instead.
+        sranks, cranks, tester = assign_roles(
+            size, int(cfg.get("master_freq", 2)), str(cfg.get("tester", "none"))
+        )
+        accel_rank = tester if tester is not None else cranks[0]
+        return {
+            r: {"JAX_PLATFORMS": "cpu"} for r in range(size) if r != accel_rank
+        }
+    raise ValueError(
+        f"device_policy must be inherit|cpu|workers_accel, got {policy!r}"
+    )
 
 
 def launch_processes(cfg: Config, timeout: float = 3600.0) -> Dict[int, Dict[str, Any]]:
@@ -143,7 +175,10 @@ def launch_processes(cfg: Config, timeout: float = 3600.0) -> Dict[int, Dict[str
         )
     from mpit_tpu.train.gang import launch_gang
 
-    return launch_gang("mpit_tpu.train.launch", cfg, timeout)
+    return launch_gang(
+        "mpit_tpu.train.launch", cfg, timeout,
+        env_overrides=device_env_overrides(cfg, int(cfg.np)),
+    )
 
 
 def main(argv: Optional[List[str]] = None) -> None:

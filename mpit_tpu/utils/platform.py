@@ -1,0 +1,23 @@
+"""Platform selection helper.
+
+Some environments preload an accelerator plugin whose platform wins over
+the ``JAX_PLATFORMS`` env var (observed with tunneled-TPU plugins); the
+reliable override is the live config knob.  Call before any jax backend
+use — process entry points (gang children, benchmark scripts, the graft
+entry) all route through this.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> str | None:
+    """Force the platform named by ``JAX_PLATFORMS`` (if set) through
+    jax.config, returning it.  No-op when unset."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    return plat or None

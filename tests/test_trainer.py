@@ -147,3 +147,40 @@ class TestTopologies:
         results = run_topology(2, cfg, small_data)
         assert results[0]["role"] == "server" and results[0]["grads_applied"] > 0
         assert results[1]["role"] == "worker"
+
+
+class TestDevicePolicy:
+    def test_overrides_shapes(self):
+        from mpit_tpu.train.launch import LAUNCH_DEFAULTS, device_env_overrides
+
+        cfg = LAUNCH_DEFAULTS.merged(np=4)
+        assert device_env_overrides(cfg, 4) == {}
+        cfg = cfg.merged(device_policy="cpu")
+        ov = device_env_overrides(cfg, 4)
+        assert set(ov) == {0, 1, 2, 3}
+        assert all(v == {"JAX_PLATFORMS": "cpu"} for v in ov.values())
+        cfg = cfg.merged(device_policy="workers_accel")
+        ov = device_env_overrides(cfg, 4)
+        # master_freq=2: even ranks are servers; of the clients {1, 3}
+        # only the first keeps the accelerator -> all but rank 1 forced.
+        assert set(ov) == {0, 2, 3}
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="device_policy"):
+            device_env_overrides(cfg.merged(device_policy="gpu4"), 4)
+
+    def test_gang_applies_policy(self, monkeypatch):
+        """np=2 gang with device_policy=cpu: children report the forced
+        platform.  The parent's inherited JAX_PLATFORMS is removed so the
+        assertion can only pass through the env_overrides plumbing (on an
+        accelerator host a broken override would surface as a non-cpu
+        platform or a chip-contention failure)."""
+        from mpit_tpu.train.launch import LAUNCH_DEFAULTS, launch_processes
+
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        cfg = LAUNCH_DEFAULTS.merged(
+            np=2, opt="downpour", epochs=1, model="linear", side=8,
+            batch=64, device_policy="cpu", master_freq=2,
+        )
+        results = launch_processes(cfg, timeout=600)
+        assert set(results) == {0, 1}
+        assert all(r.get("platform") == "cpu" for r in results.values())
