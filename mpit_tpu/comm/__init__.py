@@ -16,6 +16,9 @@ caller buffers, cancellable — is provided by three backends:
   the analog of how the reference is actually exercised (``mpirun -np N``
   on one host, reference README.md:28-31); ctypes bindings are generated
   from JSON specs, mirroring the reference's readspec.py codegen.
+- :class:`mpit_tpu.comm.tcp.TcpTransport`: cross-host sockets with the
+  identical contract — the DCN-side transport for the reference's
+  multi-node hostfile deployments (reference BiCNN/hostfiles).
 - :mod:`mpit_tpu.comm.collectives`: the on-ICI path — shard exchange
   expressed as XLA collectives (ppermute/psum/all_gather) under shard_map,
   for the gang-scheduled synchronous modes where devices run in lockstep.
@@ -23,5 +26,9 @@ caller buffers, cancellable — is provided by three backends:
 
 from mpit_tpu.comm.transport import Handle, Transport
 from mpit_tpu.comm.local import LocalRouter, LocalTransport
+from mpit_tpu.comm.tcp import TcpTransport, allocate_local_addresses
 
-__all__ = ["Transport", "Handle", "LocalRouter", "LocalTransport"]
+__all__ = [
+    "Transport", "Handle", "LocalRouter", "LocalTransport",
+    "TcpTransport", "allocate_local_addresses",
+]

@@ -1,0 +1,298 @@
+"""TcpTransport — cross-host transport for the PS protocol (DCN analog).
+
+The shm transport covers the reference's one-host ``mpirun -np N`` shape;
+this covers its multi-node hostfile deployments (reference
+BiCNN/hostfiles, README.md:57-61) for the *host-mediated* asynchronous PS
+path — the traffic XLA collectives can't express.  (On-mesh trainers
+already cross hosts via jax.distributed + DCN; this is the transport for
+the ParamServer/ParamClient role topology.)
+
+Same contract and semantics as :class:`mpit_tpu.comm.shm.ShmTransport`:
+nonblocking (rank, tag)-addressed messaging, FIFO per channel, exact-size
+receives, buffer ownership until ``test`` is True, cancel-on-shutdown.
+
+Wire format per message: 16-byte header (tag int64, size int64, little
+endian) + payload.  Connections form a full mesh at construction: every
+rank listens on its ``host:port`` from the address book; rank i dials
+every rank j < i and accepts from every j > i (each side identifies
+itself with an 8-byte rank handshake).  One reader thread per peer
+drains frames into per-channel queues; sends run on a per-peer writer
+thread so ``isend`` never blocks on a slow peer.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mpit_tpu.comm.transport import (
+    Handle,
+    Transport,
+    as_bytes_view,
+    as_writable_view,
+)
+
+_HDR = struct.Struct("<qq")  # tag, size
+_RANK_HDR = struct.Struct("<q")
+
+
+def allocate_local_addresses(nranks: int) -> Tuple[List[str], List[socket.socket]]:
+    """Pre-bound localhost listeners with OS-assigned ports, for tests and
+    same-host runs: returns (addresses, listeners); pass ``listeners[r]``
+    to rank r's transport so no port is lost to a rebind race."""
+    addrs, socks = [], []
+    for _ in range(nranks):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        s.listen(nranks)
+        addrs.append(f"127.0.0.1:{s.getsockname()[1]}")
+        socks.append(s)
+    return addrs, socks
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            return None  # peer closed
+        got += r
+    return bytes(buf)
+
+
+class _Channel:
+    __slots__ = ("msgs", "pending")
+
+    def __init__(self):
+        self.msgs: deque = deque()      # fully-assembled payloads (bytes)
+        self.pending: deque = deque()   # posted recv handles, FIFO
+
+
+class TcpTransport(Transport):
+    def __init__(
+        self,
+        rank: int,
+        nranks: int,
+        addresses: Sequence[str],
+        *,
+        listener: Optional[socket.socket] = None,
+        connect_timeout: float = 60.0,
+    ):
+        if len(addresses) != nranks:
+            raise ValueError(f"need {nranks} addresses, got {len(addresses)}")
+        self.rank = rank
+        self.nranks = nranks
+        self._lock = threading.Lock()
+        self._channels: Dict[Tuple[int, int], _Channel] = defaultdict(_Channel)
+        self._peers: Dict[int, socket.socket] = {}
+        self._outboxes: Dict[int, deque] = {r: deque() for r in range(nranks)}
+        self._out_cv: Dict[int, threading.Condition] = {
+            r: threading.Condition() for r in range(nranks)
+        }
+        self._threads: List[threading.Thread] = []
+        self._closed = False
+
+        host, _, port = addresses[rank].rpartition(":")
+        if listener is None:
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind((host or "0.0.0.0", int(port)))
+            listener.listen(nranks)
+        self._listener = listener
+
+        # Dial lower ranks, accept higher ranks (deadlock-free full mesh).
+        deadline = time.monotonic() + connect_timeout
+        for peer in range(rank):
+            self._peers[peer] = self._dial(addresses[peer], deadline)
+        for _ in range(nranks - rank - 1):
+            conn, _addr = self._accept(deadline)
+            peer_hdr = _recv_exact(conn, _RANK_HDR.size)
+            if peer_hdr is None:
+                raise ConnectionError("peer closed during handshake")
+            (peer,) = _RANK_HDR.unpack(peer_hdr)
+            self._peers[int(peer)] = conn
+        for peer, conn in self._peers.items():
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._spawn(self._reader, peer, conn)
+            self._spawn(self._writer, peer, conn)
+
+    # -- connection plumbing -------------------------------------------------
+
+    def _dial(self, address: str, deadline: float) -> socket.socket:
+        host, _, port = address.rpartition(":")
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                conn = socket.create_connection((host, int(port)), timeout=5.0)
+                conn.settimeout(None)
+                conn.sendall(_RANK_HDR.pack(self.rank))
+                return conn
+            except OSError as e:  # peer not up yet
+                last_err = e
+                time.sleep(0.05)
+        raise ConnectionError(f"could not reach {address}: {last_err!r}")
+
+    def _accept(self, deadline: float) -> Tuple[socket.socket, Any]:
+        self._listener.settimeout(max(deadline - time.monotonic(), 0.1))
+        try:
+            return self._listener.accept()
+        except socket.timeout:
+            raise ConnectionError("timed out waiting for peer connections")
+
+    def _spawn(self, fn, *args) -> None:
+        t = threading.Thread(target=fn, args=args, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _reader(self, peer: int, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = _recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                tag, size = _HDR.unpack(hdr)
+                payload = _recv_exact(conn, int(size)) if size else b""
+                if payload is None:
+                    return
+                with self._lock:
+                    self._channels[(peer, int(tag))].msgs.append(payload)
+        except OSError:
+            return  # socket torn down by close()
+
+    def _writer(self, peer: int, conn: socket.socket) -> None:
+        cv = self._out_cv[peer]
+        box = self._outboxes[peer]
+        while True:
+            with cv:
+                while not box and not self._closed:
+                    cv.wait(0.5)
+                if self._closed and not box:
+                    return
+                handle, header, payload = box.popleft()
+            try:
+                conn.sendall(header)
+                if payload:
+                    conn.sendall(payload)
+            except OSError:
+                # Dead peer/socket: cancel this and every queued send so
+                # blocking senders unblock instead of spinning forever.
+                handle.cancelled = True
+                handle.buf = None
+                self._drain_outbox(peer)
+                return
+            handle.done = True
+            handle.buf = None  # ownership back to the caller
+
+    def _drain_outbox(self, peer: int) -> None:
+        cv = self._out_cv[peer]
+        with cv:
+            while self._outboxes[peer]:
+                h, _hdr, _payload = self._outboxes[peer].popleft()
+                h.cancelled = True
+                h.buf = None
+
+    # -- Transport -----------------------------------------------------------
+
+    def isend(self, data: Any, dst: int, tag: int) -> Handle:
+        if dst == self.rank or not 0 <= dst < self.nranks:
+            raise ValueError(f"isend to invalid rank {dst}")
+        if self._closed:
+            raise RuntimeError("isend on a closed transport")
+        view = as_bytes_view(b"" if data is None else data)
+        handle = Handle(kind="send", peer=dst, tag=tag, buf=data)
+        # One payload snapshot honors the ownership contract (caller may
+        # reuse the buffer as soon as test() is True, which we only report
+        # after sendall); the writer sends header and payload separately
+        # to avoid a second payload-sized copy.
+        payload = bytes(view)
+        cv = self._out_cv[dst]
+        with cv:
+            self._outboxes[dst].append(
+                (handle, _HDR.pack(tag, len(payload)), payload)
+            )
+            cv.notify()
+        return handle
+
+    def irecv(self, src: int, tag: int, out: Any | None = None) -> Handle:
+        if src == self.rank or not 0 <= src < self.nranks:
+            raise ValueError(f"irecv from invalid rank {src}")
+        handle = Handle(kind="recv", peer=src, tag=tag, out=out)
+        if out is None:
+            handle.meta["as_bytes"] = True
+        with self._lock:
+            self._channels[(src, tag)].pending.append(handle)
+        return handle
+
+    def iprobe(self, src: int, tag: int) -> bool:
+        with self._lock:
+            return bool(self._channels[(src, tag)].msgs)
+
+    def test(self, handle: Handle) -> bool:
+        if handle.done or handle.cancelled:
+            return handle.done
+        if handle.kind == "send":
+            return handle.done
+        with self._lock:
+            chan = self._channels[(handle.peer, handle.tag)]
+            while chan.pending and chan.pending[0].cancelled:
+                chan.pending.popleft()
+            if not chan.pending or chan.pending[0] is not handle or not chan.msgs:
+                return False
+            msg = chan.msgs[0]
+            if handle.meta.get("as_bytes"):
+                chan.msgs.popleft()
+                chan.pending.popleft()
+                handle.payload = msg
+                handle.done = True
+                return True
+            view = as_writable_view(handle.out)
+            if view.nbytes != len(msg):
+                handle.cancelled = True
+                chan.pending.popleft()  # message stays for a correct recv
+                raise ValueError(
+                    f"recv size mismatch: message {len(msg)}B does not fit "
+                    f"buffer {view.nbytes}B (src={handle.peer}, tag={handle.tag})"
+                )
+            chan.msgs.popleft()
+            chan.pending.popleft()
+            view[:] = msg
+            handle.done = True
+            return True
+
+    def cancel(self, handle: Handle) -> None:
+        handle.cancelled = True
+        handle.buf = None  # pending-queue entries are reaped lazily in test
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # Cancel every queued send first — a blocking sender must observe
+        # done-or-cancelled, never an orphaned handle.
+        for peer in range(self.nranks):
+            if peer != self.rank:
+                self._drain_outbox(peer)
+        for cv in self._out_cv.values():
+            with cv:
+                cv.notify_all()
+        for conn in self._peers.values():
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(2)

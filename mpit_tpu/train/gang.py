@@ -23,6 +23,19 @@ from mpit_tpu.utils.config import Config
 
 
 def child_transport(cfg: Config, rank: int, size: int):
+    """The gang's wire: shm rings on one host (default), TCP across hosts
+    (``transport=tcp`` + ``tcp_addrs=host:port,...`` — one address per
+    rank, the hostfile-deployment analog)."""
+    if cfg.get("transport", "shm") == "tcp":
+        from mpit_tpu.comm.tcp import TcpTransport
+
+        addrs = [a for a in str(cfg.get("tcp_addrs", "")).split(",") if a]
+        if len(addrs) != size:
+            raise ValueError(
+                f"transport=tcp needs {size} comma-separated tcp_addrs, "
+                f"got {len(addrs)}"
+            )
+        return TcpTransport(rank, size, addrs)
     from mpit_tpu.comm.shm import ShmTransport
 
     return ShmTransport(

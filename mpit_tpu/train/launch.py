@@ -47,6 +47,10 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     # mlaunch.lua:56-62): inherit | cpu | workers_accel (one compute rank
     # — tester else first client — owns the accelerator, rest CPU).
     device_policy="inherit",
+    # Gang wire: shm (one host) | tcp (cross-host; tcp_addrs = one
+    # host:port per rank, comma-separated — the hostfile analog).
+    transport="shm",
+    tcp_addrs="",
 )
 
 
