@@ -40,5 +40,7 @@ from mpit_tpu.parallel.tensor_parallel import (  # noqa: F401
 from mpit_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     sp_mesh,
+    zigzag_permute,
+    zigzag_unpermute,
 )
 from mpit_tpu.parallel.sync_dp import SyncDataParallel  # noqa: F401

@@ -24,8 +24,10 @@ the blockwise math) and ``pallas`` (the flash kernel emitting partials;
 forward wrapped in a custom VJP whose backward recomputes through the
 jnp ring — per-chunk blockwise memory, no O(L²) materialization).
 
-Causal ring attention computes all n steps on every device (the usual
-non-load-balanced ring; a zigzag layout is a later optimization).
+Causal ring attention has two layouts: ``contiguous`` (every device
+computes all n steps, most of them fully masked on low-rank devices) and
+``zigzag`` (each device owns an early + late half-chunk, balancing the
+causal work — see :func:`_ring_chunks_zigzag`).
 """
 
 from __future__ import annotations
@@ -80,35 +82,148 @@ def _ring_chunks(q, k, v, *, axis, n, partial_fn):
     return finalize_partials(acc, l, dtype=q.dtype)
 
 
+def _ring_chunks_zigzag(q, k, v, *, axis, n, partial_fn):
+    """Load-balanced causal ring: each device holds TWO half-chunks of the
+    zigzag layout — global chunk ``d`` and chunk ``2n-1-d`` — so causal
+    useful work is ~2 half-blocks per device per step instead of the
+    contiguous layout's all-or-nothing (device 0 would mask away n-1 of
+    its n steps while device n-1 computes all of them).
+
+    Liveness per (q-half, kv-half) pair at ring step s (owner ``o``):
+    (early_q=d, early_kv=o) live iff d >= o (runtime); (early_q,
+    late_kv=2n-1-o) never live (late chunks are always ahead of early
+    ones); (late_q=2n-1-d, early_kv) always live; (late_q, late_kv) live
+    iff o >= d (runtime).  The two static cases are resolved at trace
+    time; the two data-dependent ones are ``lax.cond`` so dead blocks
+    cost nothing at runtime.
+    """
+    my = jax.lax.axis_index(axis)
+    c = q.shape[-2] // 2
+    q_halves = (q[..., :c, :], q[..., c:, :])
+    q_offs = (my * c, (2 * n - 1 - my) * c)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def zero_like_part(qh):
+        return (
+            jnp.zeros(qh.shape[:-1] + (v.shape[-1],), jnp.float32),
+            jnp.full(qh.shape[:-1], float("-inf"), jnp.float32),
+            jnp.zeros(qh.shape[:-1], jnp.float32),
+        )
+
+    parts = [zero_like_part(qh) for qh in q_halves]
+    kb, vb = k, v
+    for s in range(n):
+        owner = (my + (n - s)) % n
+        kv_halves = (
+            (kb[..., :c, :], vb[..., :c, :]),
+            (kb[..., c:, :], vb[..., c:, :]),
+        )
+        kv_offs = (owner * c, (2 * n - 1 - owner) * c)
+
+        def compute(qi, ki):
+            return partial_fn(
+                q_halves[qi], kv_halves[ki][0], kv_halves[ki][1],
+                q_offs[qi], kv_offs[ki],
+            )
+
+        # (late_q, early_kv): statically live.
+        parts[1] = merge_partials(parts[1], compute(1, 0))
+        # (early_q, early_kv): live iff my >= owner.
+        parts[0] = merge_partials(
+            parts[0],
+            jax.lax.cond(
+                my >= owner, lambda: compute(0, 0),
+                lambda: zero_like_part(q_halves[0]),
+            ),
+        )
+        # (late_q, late_kv): live iff owner >= my.
+        parts[1] = merge_partials(
+            parts[1],
+            jax.lax.cond(
+                owner >= my, lambda: compute(1, 1),
+                lambda: zero_like_part(q_halves[1]),
+            ),
+        )
+        # (early_q, late_kv): statically dead — skipped.
+        if s + 1 < n:
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+    outs = [
+        finalize_partials(acc, l, dtype=q.dtype) for (acc, _m, l) in parts
+    ]
+    return jnp.concatenate(outs, axis=-2)
+
+
+def zigzag_order(n: int):
+    """Global chunk ids in device order for the zigzag layout: device d
+    owns chunks (d, 2n-1-d)."""
+    order = []
+    for d in range(n):
+        order.extend([d, 2 * n - 1 - d])
+    return order
+
+
+def zigzag_permute(x: jnp.ndarray, n: int, axis: int = 1) -> jnp.ndarray:
+    """Reorder a sequence axis of 2n equal chunks into the zigzag device
+    layout (inverse: :func:`zigzag_unpermute`)."""
+    L = x.shape[axis]
+    if L % (2 * n):
+        raise ValueError(f"sequence length {L} not divisible by 2n={2 * n}")
+    c = L // (2 * n)
+    idx = jnp.concatenate(
+        [jnp.arange(g * c, (g + 1) * c) for g in zigzag_order(n)]
+    )
+    return jnp.take(x, idx, axis=axis)
+
+
+def zigzag_unpermute(x: jnp.ndarray, n: int, axis: int = 1) -> jnp.ndarray:
+    L = x.shape[axis]
+    if L % (2 * n):
+        raise ValueError(f"sequence length {L} not divisible by 2n={2 * n}")
+    c = L // (2 * n)
+    order = zigzag_order(n)
+    inv = [0] * (2 * n)
+    for pos, g in enumerate(order):
+        inv[g] = pos
+    idx = jnp.concatenate(
+        [jnp.arange(p * c, (p + 1) * c) for p in inv]
+    )
+    return jnp.take(x, idx, axis=axis)
+
+
 def _precision_ctx(precision):
     return (jax.default_matmul_precision(precision) if precision
             else contextlib.nullcontext())
 
 
-def _ring_jnp(q, k, v, *, axis, n, causal, sm_scale, precision=None):
+_RING_LOOPS = {"contiguous": _ring_chunks, "zigzag": _ring_chunks_zigzag}
+
+
+def _ring_jnp(q, k, v, *, axis, n, causal, sm_scale, precision=None,
+              layout="contiguous"):
     fn = lambda q2, k2, v2, qo, ko: block_attention_partial(
         q2, k2, v2, causal=causal, sm_scale=sm_scale, q_offset=qo, kv_offset=ko
     )
     with _precision_ctx(precision):
-        return _ring_chunks(q, k, v, axis=axis, n=n, partial_fn=fn)
+        return _RING_LOOPS[layout](q, k, v, axis=axis, n=n, partial_fn=fn)
 
 
 def _ring_pallas(q, k, v, *, axis, n, causal, sm_scale, block_q, block_k,
-                 interpret, precision):
+                 interpret, precision, layout="contiguous"):
     fn = lambda q2, k2, v2, qo, ko: flash_attention_partial(
         q2, k2, v2, causal=causal, sm_scale=sm_scale, q_offset=qo,
         kv_offset=ko, block_q=block_q, block_k=block_k, interpret=interpret,
         precision=precision,
     )
-    return _ring_chunks(q, k, v, axis=axis, n=n, partial_fn=fn)
+    return _RING_LOOPS[layout](q, k, v, axis=axis, n=n, partial_fn=fn)
 
 
 @functools.lru_cache(maxsize=None)
 def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
-                   interpret, precision):
+                   interpret, precision, layout="contiguous"):
     jnp_fn = functools.partial(
         _ring_jnp, axis=axis, n=n, causal=causal, sm_scale=sm_scale,
-        precision=precision,
+        precision=precision, layout=layout,
     )
     if impl == "jnp":
         return jnp_fn
@@ -116,7 +231,7 @@ def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
     pallas_fwd = functools.partial(
         _ring_pallas, axis=axis, n=n, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        precision=precision,
+        precision=precision, layout=layout,
     )
 
     @jax.custom_vjp
@@ -148,21 +263,39 @@ def ring_attention(
     block_k: int = 512,
     interpret: bool | None = None,
     precision: str | None = None,
+    layout: str = "contiguous",
+    permute_inputs: bool = True,
 ) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]:
     """Build the sequence-parallel attention fn over ``mesh[axis]``.
 
     Takes/returns global ``(B, L, H, D)`` arrays with L sharded over
     ``axis`` (L must divide evenly).  ``impl``: 'jnp', 'pallas', or
     'auto' (pallas on TPU, jnp elsewhere).  Callable from inside jit.
+
+    ``layout='zigzag'`` (causal only) balances causal work across the
+    ring — each device owns an early and a late half-chunk, halving the
+    worst-device compute per step.  With ``permute_inputs`` (default) the
+    returned fn takes/returns natural sequence order, paying one
+    cross-shard permutation per call; a model calling attention per layer
+    can instead pre-permute activations once with
+    :func:`zigzag_permute`, pass ``permute_inputs=False``, and
+    un-permute final outputs with :func:`zigzag_unpermute`.
     """
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "jnp"
     if impl not in ("jnp", "pallas"):
         raise ValueError(f"impl must be auto|jnp|pallas, got {impl!r}")
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"layout must be contiguous|zigzag, got {layout!r}")
+    if layout == "zigzag" and not causal:
+        raise ValueError(
+            "layout='zigzag' requires causal=True (the static block-"
+            "liveness it exploits is the causal structure)"
+        )
     n = mesh.shape[axis]
     local = _make_local_fn(
         axis, n, bool(causal), sm_scale, impl, int(block_q), int(block_k),
-        interpret, precision,
+        interpret, precision, layout,
     )
 
     def _local(q, k, v):
@@ -171,7 +304,15 @@ def ring_attention(
         return local(qh, kh, vh).transpose(0, 2, 1, 3)
 
     spec = P(None, axis, None, None)
-    return shard_map(
+    mapped = shard_map(
         _local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
+    if layout == "contiguous" or not permute_inputs:
+        return mapped
+
+    def zigzagged(q, k, v):
+        qz, kz, vz = (zigzag_permute(x, n, axis=1) for x in (q, k, v))
+        return zigzag_unpermute(mapped(qz, kz, vz), n, axis=1)
+
+    return zigzagged

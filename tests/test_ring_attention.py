@@ -115,3 +115,45 @@ def test_decoder_trains_with_ring(rng, mesh):
         loss, g = vg(w)
         w = w - 0.5 * g
     assert float(loss) < float(l0) - 0.1, (float(l0), float(loss))
+
+
+class TestZigzag:
+    """Load-balanced causal layout: still exactly full attention."""
+
+    def test_permute_roundtrip(self, rng, mesh):
+        from mpit_tpu.parallel.ring_attention import (
+            zigzag_permute, zigzag_unpermute,
+        )
+
+        x = jnp.asarray(rng.normal(size=(2, 64, 3)), jnp.float32)
+        z = zigzag_permute(x, 8)
+        assert z.shape == x.shape
+        np.testing.assert_array_equal(
+            np.asarray(zigzag_unpermute(z, 8)), np.asarray(x)
+        )
+        # Device 0's first half-chunk is global chunk 0; second is chunk 15.
+        c = 64 // 16
+        np.testing.assert_array_equal(np.asarray(z[:, :c]), np.asarray(x[:, :c]))
+        np.testing.assert_array_equal(
+            np.asarray(z[:, c:2 * c]), np.asarray(x[:, 15 * c:])
+        )
+
+    @pytest.mark.parametrize("impl", ["jnp", "pallas"])
+    def test_matches_full(self, rng, mesh, impl):
+        q, k, v = _qkv(rng)  # L=64 = 2*8*4
+        ring = ring_attention(mesh, causal=True, impl=impl, layout="zigzag")
+        out = jax.jit(ring)(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_ref(q, k, v, True)), atol=3e-5
+        )
+
+    def test_grads_match_full(self, rng, mesh):
+        q, k, v = _qkv(rng)
+        ring = ring_attention(mesh, causal=True, impl="jnp", layout="zigzag")
+        g1 = jax.jit(jax.grad(lambda q: jnp.sum(ring(q, k, v) ** 2)))(q)
+        g2 = jax.grad(lambda q: jnp.sum(_ref(q, k, v, True) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=5e-5)
+
+    def test_zigzag_requires_causal(self, mesh):
+        with pytest.raises(ValueError, match="causal"):
+            ring_attention(mesh, causal=False, layout="zigzag")
