@@ -44,7 +44,9 @@ def bench_jax() -> float:
     from mpit_tpu.optim.msgd import MSGDConfig
     from mpit_tpu.parallel import MeshEASGD, make_mesh
 
-    devs = jax.devices()
+    from mpit_tpu.utils.platform import default_devices
+
+    devs = default_devices()
     _log(f"jax devices: {devs}")
     mesh = make_mesh(devs)
     n_dp = mesh.shape["dp"]

@@ -53,7 +53,9 @@ def bench_ici() -> dict:
     from mpit_tpu.parallel.collective import ps_pushpull
     from mpit_tpu.parallel.mesh import param_sharding
 
-    devs = jax.devices()
+    from mpit_tpu.utils.platform import default_devices
+
+    devs = default_devices()
     mesh = make_mesh(devs, dp=1)  # all devices on the shard axis
     n = mesh.shape["shard"]
     size = int(MB * (1 << 20) / 4 // n * n)

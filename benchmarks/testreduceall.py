@@ -44,7 +44,9 @@ def main():
     from jax import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    devs = jax.devices()
+    from mpit_tpu.utils.platform import default_devices
+
+    devs = default_devices()
     n = len(devs)
     mesh = Mesh(np.array(devs), ("x",))
     size = int(MEGS * (1 << 20) / 4 // n * n)

@@ -25,6 +25,11 @@ With ``mva = beta/p`` (the mlaunch config, reference mlaunch.lua:42) the
 center moves by ``beta * (mean_i(w_i) - w*)`` per sync — the synchronous
 EASGD of the paper.  All state stays in HBM across steps; nothing touches
 the host.
+
+Note on the historic intermittent ``Fatal Python error: Aborted`` under
+the virtual-CPU test platform: an XLA:CPU collective-rendezvous
+thread-starvation limitation, not a defect in this program — root cause
+and workaround in docs/xla_cpu_rendezvous_abort.md.
 """
 
 from __future__ import annotations

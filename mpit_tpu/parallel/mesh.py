@@ -38,7 +38,9 @@ def make_mesh(
     larger factor (workers usually outnumber shard groups, as in the
     reference's 6-worker/6-server mlaunch split).
     """
-    devs = list(devices if devices is not None else jax.devices())
+    from mpit_tpu.utils.platform import default_devices
+
+    devs = list(devices if devices is not None else default_devices())
     n = len(devs)
     if dp is None and shard is None:
         shard = _largest_divisor_at_most(n, int(np.sqrt(n)))

@@ -52,7 +52,9 @@ from mpit_tpu.ops.flash_attention import (
 
 def sp_mesh(devices: Sequence[jax.Device] | None = None, axis: str = "sp") -> Mesh:
     """1-D sequence-parallel mesh over all (or the given) devices."""
-    devs = list(devices if devices is not None else jax.devices())
+    from mpit_tpu.utils.platform import default_devices
+
+    devs = list(devices if devices is not None else default_devices())
     return Mesh(np.array(devs), (axis,))
 
 

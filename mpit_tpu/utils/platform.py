@@ -21,3 +21,59 @@ def honor_jax_platforms() -> str | None:
 
         jax.config.update("jax_platforms", plat)
     return plat or None
+
+
+# -- virtual-CPU-mesh headroom ------------------------------------------------
+#
+# XLA:CPU sizes the PjRt client's execution thread pool to the virtual
+# device count (``--xla_force_host_platform_device_count``).  A program
+# sharded over *every* virtual device needs one pool thread per partition
+# simultaneously; when any pool thread is busy with other client work, one
+# partition never starts, every other partition blocks inside the
+# cross-device collective rendezvous, and after a 40 s timeout XLA calls
+# ``LOG(FATAL)`` -> ``Fatal Python error: Aborted`` (xla rendezvous.cc:127,
+# ``InProcessCommunicator::AllReduce``).  Observed ~1 in 500 executions of
+# an 8-way-sharded all-reduce program on an 8-device pool; zero in >10^4
+# executions once the pool exceeds the mesh.  See
+# docs/xla_cpu_rendezvous_abort.md for the full investigation.
+#
+# Workaround convention: register more virtual devices than any mesh uses,
+# and have mesh builders draw from ``default_devices()`` (the first
+# ``MPIT_MESH_DEVICES`` devices) rather than ``jax.devices()``.
+
+CPU_POOL_HEADROOM = 4
+
+
+def ensure_cpu_device_headroom(n_mesh_devices: int, extra: int = CPU_POOL_HEADROOM) -> None:
+    """Append a ``--xla_force_host_platform_device_count`` override so the
+    host-CPU platform exposes ``n_mesh_devices + extra`` virtual devices
+    (the later duplicate flag wins), and pin ``MPIT_MESH_DEVICES`` so mesh
+    builders keep using only ``n_mesh_devices``.
+
+    Must run before the jax backend initializes; harmless (ignored by
+    XLA) afterwards.  A no-op unless the selected platform is the host
+    CPU — on real TPU neither the flag nor the mesh cap applies.
+    """
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS") or jax.config.jax_platforms or ""
+    if not plat.split(",")[0].strip() == "cpu":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_mesh_devices + extra}"
+    ).strip()
+    os.environ["MPIT_MESH_DEVICES"] = str(n_mesh_devices)
+
+
+def default_devices():
+    """The device pool meshes should span: the first ``MPIT_MESH_DEVICES``
+    of ``jax.devices()`` when that env var is set (CPU-pool-headroom
+    convention above), else all devices."""
+    import jax
+
+    devs = jax.devices()
+    cap = os.environ.get("MPIT_MESH_DEVICES")
+    if cap:
+        devs = devs[: int(cap)]
+    return devs

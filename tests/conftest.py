@@ -10,11 +10,17 @@ SURVEY.md section 4).  Must run before jax is imported anywhere.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # override any preset TPU/axon platform
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# 8 mesh devices + pool headroom: XLA:CPU sizes the client thread pool to
+# the virtual device count, and a program sharded over every device then
+# deadlocks its collective rendezvous whenever any pool thread is busy
+# with other work (fatal abort after 40 s — docs/xla_cpu_rendezvous_abort.md).
+# The extra devices are never meshed (MPIT_MESH_DEVICES caps the pool via
+# mpit_tpu.utils.platform.default_devices); they only widen the pool.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=12"
+).strip()
+os.environ["MPIT_MESH_DEVICES"] = "8"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 # The environment may pre-import jax at interpreter startup (e.g. a TPU

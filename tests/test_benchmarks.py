@@ -19,7 +19,9 @@ def run_bench(script, extra_env, timeout=420):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        # 4 mesh devices + pool headroom (docs/xla_cpu_rendezvous_abort.md)
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        MPIT_MESH_DEVICES="4",
         MPIT_BENCH_ROUNDS="2",
         **extra_env,
     )

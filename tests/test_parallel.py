@@ -26,13 +26,15 @@ from mpit_tpu.parallel import (
 
 @pytest.fixture(scope="module")
 def mesh():
-    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    from mpit_tpu.utils.platform import default_devices
+
+    assert len(default_devices()) == 8, "conftest must provide 8 mesh devices"
     return make_mesh(dp=4, shard=2)
 
 
 def test_make_mesh_factoring():
     m = make_mesh()
-    assert m.shape["dp"] * m.shape["shard"] == 8
+    assert m.shape["dp"] * m.shape["shard"] == 8  # capped by MPIT_MESH_DEVICES
     with pytest.raises(ValueError):
         make_mesh(dp=3)
 

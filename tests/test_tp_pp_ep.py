@@ -19,7 +19,9 @@ from mpit_tpu.parallel import (
 
 
 def _mesh(axis, n=8):
-    return Mesh(np.array(jax.devices()[:n]), (axis,))
+    from mpit_tpu.utils.platform import default_devices
+
+    return Mesh(np.array(default_devices()[:n]), (axis,))
 
 
 def _arr(rng, *shape):
