@@ -17,7 +17,10 @@ rank listens on its ``host:port`` from the address book; rank i dials
 every rank j < i and accepts from every j > i (each side identifies
 itself with an 8-byte rank handshake).  One reader thread per peer
 drains frames into per-channel queues; sends run on a per-peer writer
-thread so ``isend`` never blocks on a slow peer.
+thread so ``isend`` never blocks on a slow peer.  The outbox is
+zero-copy — queued entries view the caller's buffer (owned by the
+transport until ``test`` is True), so a deep backlog costs O(1)
+transport-owned memory per message, not a payload copy.
 """
 
 from __future__ import annotations
@@ -98,6 +101,10 @@ class TcpTransport(Transport):
         self._out_cv: Dict[int, threading.Condition] = {
             r: threading.Condition() for r in range(nranks)
         }
+        # Peers whose writer thread has died (socket error): new isends
+        # are cancelled immediately instead of queueing into a box nobody
+        # drains.
+        self._dead_peers: set = set()
         self._threads: List[threading.Thread] = []
         self._closed = False
 
@@ -180,25 +187,36 @@ class TcpTransport(Transport):
                 handle, header, payload = box.popleft()
             try:
                 conn.sendall(header)
-                if payload:
+                if payload.nbytes:
                     conn.sendall(payload)
             except OSError:
-                # Dead peer/socket: cancel this and every queued send so
-                # blocking senders unblock instead of spinning forever.
+                # Dead peer/socket: cancel this and every queued send with
+                # a recorded error so blocking senders get a raise from
+                # test() (the shm transport's raise-once convention)
+                # instead of spinning forever.
+                err = f"send to rank {peer} failed: connection lost"
                 handle.cancelled = True
                 handle.buf = None
-                self._drain_outbox(peer)
+                handle.meta["error"] = err
+                self._drain_outbox(peer, error=err)
                 return
             handle.done = True
             handle.buf = None  # ownership back to the caller
 
-    def _drain_outbox(self, peer: int) -> None:
+    def _drain_outbox(self, peer: int, error: str | None = None) -> None:
+        """Cancel every queued send to ``peer``.  With ``error`` (dead
+        peer) the handles raise from ``test``; without (orderly close)
+        they cancel silently."""
         cv = self._out_cv[peer]
         with cv:
+            self._dead_peers.add(peer)
+            cv.notify_all()
             while self._outboxes[peer]:
                 h, _hdr, _payload = self._outboxes[peer].popleft()
                 h.cancelled = True
                 h.buf = None
+                if error:
+                    h.meta["error"] = error
 
     # -- Transport -----------------------------------------------------------
 
@@ -209,15 +227,20 @@ class TcpTransport(Transport):
             raise RuntimeError("isend on a closed transport")
         view = as_bytes_view(b"" if data is None else data)
         handle = Handle(kind="send", peer=dst, tag=tag, buf=data)
-        # One payload snapshot honors the ownership contract (caller may
-        # reuse the buffer as soon as test() is True, which we only report
-        # after sendall); the writer sends header and payload separately
-        # to avoid a second payload-sized copy.
-        payload = bytes(view)
+        # Zero-copy queue: the outbox holds a *view* over the caller's
+        # buffer, not a snapshot — the ownership contract already forbids
+        # the caller touching it until test() is True (reported only
+        # after sendall), so transport-owned memory stays O(1) per queued
+        # message however deep the backlog, and isend never blocks.
         cv = self._out_cv[dst]
         with cv:
+            if dst in self._dead_peers:
+                handle.cancelled = True
+                handle.buf = None
+                handle.meta["error"] = f"rank {dst} unreachable (writer dead)"
+                return handle
             self._outboxes[dst].append(
-                (handle, _HDR.pack(tag, len(payload)), payload)
+                (handle, _HDR.pack(tag, view.nbytes), view)
             )
             cv.notify()
         return handle
@@ -237,8 +260,13 @@ class TcpTransport(Transport):
             return bool(self._channels[(src, tag)].msgs)
 
     def test(self, handle: Handle) -> bool:
-        if handle.done or handle.cancelled:
-            return handle.done
+        if handle.cancelled:
+            err = handle.meta.pop("error", None)
+            if err:  # raise exactly once, then report not-done quietly
+                raise RuntimeError(err)
+            return False
+        if handle.done:
+            return True
         if handle.kind == "send":
             return handle.done
         with self._lock:

@@ -293,7 +293,7 @@ def flash_attention_partial(
     return f(q, k, v)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)
 def _make_flash(causal, sm_scale, block_q, block_k, interpret, precision):
     """Differentiable flash op for fixed static config: pallas forward,
     recompute-backward through the jnp reference."""
@@ -351,7 +351,13 @@ def flash_attention(
     ``precision``: MXU input precision for the two block matmuls (e.g.
     ``"highest"`` for full-f32 inputs); None uses the backend default —
     bf16 MXU passes on TPU, the standard flash-attention trade."""
-    fa = _make_flash(bool(causal), sm_scale, int(block_q), int(block_k),
+    # sm_scale is a cache key and closed over as a compile-time constant —
+    # it must be a static float, not a traced value (float() rejects
+    # tracers with a clear error instead of leaking per-trace cache
+    # entries).
+    fa = _make_flash(bool(causal),
+                     None if sm_scale is None else float(sm_scale),
+                     int(block_q), int(block_k),
                      _interpret(interpret), precision)
     return fa(q, k, v, jnp.asarray(q_offset, jnp.int32),
               jnp.asarray(kv_offset, jnp.int32))

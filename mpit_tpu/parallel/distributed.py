@@ -106,6 +106,14 @@ def bootstrap(
     ``jax.distributed.initialize()`` is called with no args by the
     runtime).
     """
+    # Process entry point: apply the JAX_PLATFORMS override before any
+    # backend init (jax.distributed.initialize / device queries below) —
+    # the env var alone loses to preloaded accelerator plugins, which
+    # also makes this path hang when a tunneled TPU is unreachable.
+    from mpit_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
     env = os.environ
     coordinator = coordinator or env.get("MPIT_COORDINATOR") or None
     if num_processes is None:

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpit_tpu.optim.msgd import MSGDConfig, msgd_commit, msgd_lookahead
+from mpit_tpu.parallel.mesh import put_global, put_local
 
 
 class MeshEASGD:
@@ -123,16 +124,16 @@ class MeshEASGD:
         pserver.lua:92-102)."""
         w = jnp.broadcast_to(w0[None, :], (self.n_dp, w0.shape[0]))
         state = {
-            "w": jax.device_put(w, self._shardings["w"]),
-            "vt": jax.device_put(jnp.zeros_like(w), self._shardings["w"]),
-            "k": jax.device_put(
+            "w": put_global(w, self._shardings["w"]),
+            "vt": put_global(jnp.zeros_like(w), self._shardings["w"]),
+            "k": put_global(
                 jnp.zeros((self.n_dp,), jnp.int32), self._shardings["k"]
             ),
             # Copy w0: device_put may alias the caller's buffer for the
             # shard landing on the same device, and _sync_jit donates the
             # center — without the copy the first sync round deletes the
             # caller's w0.
-            "center": jax.device_put(
+            "center": put_global(
                 jnp.array(w0, copy=True), self._shardings["center"]
             ),
         }
@@ -140,8 +141,9 @@ class MeshEASGD:
         return state
 
     def shard_batch(self, *arrays: jnp.ndarray):
-        """Place (n_dp, batch, ...) stacked arrays with the dp sharding."""
-        return tuple(jax.device_put(a, self._shardings["batch"]) for a in arrays)
+        """Place (n_dp, batch, ...) stacked arrays with the dp sharding.
+        Multi-process: pass only this process's worker rows."""
+        return tuple(put_local(a, self._shardings["batch"]) for a in arrays)
 
     # -- stepping ------------------------------------------------------------
 

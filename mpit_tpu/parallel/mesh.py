@@ -87,3 +87,28 @@ def worker_sharding(mesh: Mesh, *, shard_params: bool = True) -> NamedSharding:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Per-worker batches: leading dp axis, unsharded feature axes."""
     return NamedSharding(mesh, PartitionSpec("dp"))
+
+
+def put_global(arr, sharding: NamedSharding):
+    """Place a host-*global* array (every process holds the same full
+    array — init-style broadcast) with ``sharding`` across the possibly
+    multi-host mesh.
+
+    Single-process: plain ``device_put``.  Multi-process:
+    ``make_array_from_callback`` hands each addressable device its slice
+    of the global array — ``device_put`` of a host-global array cannot
+    place data on another host's devices.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    host = np.asarray(arr)
+    return jax.make_array_from_callback(host.shape, sharding, lambda idx: host[idx])
+
+
+def put_local(arr, sharding: NamedSharding):
+    """Place per-process data (data-parallel batches): each process
+    passes only the rows its addressable devices own; the global array is
+    assembled with ``jax.make_array_from_process_local_data``."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(arr))

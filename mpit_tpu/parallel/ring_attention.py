@@ -100,6 +100,11 @@ def _ring_chunks_zigzag(q, k, v, *, axis, n, partial_fn):
     cost nothing at runtime.
     """
     my = jax.lax.axis_index(axis)
+    if q.shape[-2] % 2:
+        raise ValueError(
+            f"zigzag layout needs an even per-device chunk, got "
+            f"{q.shape[-2]} (global L must divide evenly by 2n={2 * n})"
+        )
     c = q.shape[-2] // 2
     q_halves = (q[..., :c, :], q[..., c:, :])
     q_offs = (my * c, (2 * n - 1 - my) * c)
@@ -220,7 +225,7 @@ def _ring_pallas(q, k, v, *, axis, n, causal, sm_scale, block_q, block_k,
     return _RING_LOOPS[layout](q, k, v, axis=axis, n=n, partial_fn=fn)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)
 def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
                    interpret, precision, layout="contiguous"):
     jnp_fn = functools.partial(
@@ -296,7 +301,10 @@ def ring_attention(
         )
     n = mesh.shape[axis]
     local = _make_local_fn(
-        axis, n, bool(causal), sm_scale, impl, int(block_q), int(block_k),
+        axis, n, bool(causal),
+        # Static cache key: reject traced sm_scale with a clear error.
+        None if sm_scale is None else float(sm_scale),
+        impl, int(block_q), int(block_k),
         interpret, precision, layout,
     )
 

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpit_tpu.optim.msgd import MSGDConfig, msgd_commit, msgd_init, msgd_lookahead
+from mpit_tpu.parallel.mesh import put_global, put_local
 
 
 class SyncDataParallel:
@@ -65,13 +66,14 @@ class SyncDataParallel:
         # whose shard stays put, and step() donates "w" — without the copy
         # the first step deletes the caller's w0 out from under them.
         return {
-            "w": jax.device_put(jnp.array(w0, copy=True), self._param_sharding),
-            "vt": jax.device_put(jnp.zeros_like(w0), self._param_sharding),
+            "w": put_global(jnp.array(w0, copy=True), self._param_sharding),
+            "vt": put_global(jnp.zeros_like(w0), self._param_sharding),
             "k": jnp.zeros((), jnp.int32),
         }
 
     def shard_batch(self, *arrays: jnp.ndarray):
-        return tuple(jax.device_put(a, self._batch_sharding) for a in arrays)
+        """Multi-process: pass only this process's batch rows."""
+        return tuple(put_local(a, self._batch_sharding) for a in arrays)
 
     def step(self, state: Dict[str, Any], xb: jnp.ndarray, yb: jnp.ndarray):
         w, vt, k, loss = self._step_jit(state["w"], state["vt"], state["k"], xb, yb)

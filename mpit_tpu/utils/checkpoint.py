@@ -26,6 +26,7 @@ def save_flat(
 ) -> pathlib.Path:
     """Save the flat param vector; filename stamped with cumulative runtime
     (the reference's timestamped torch.save, bicnn.lua:590-594)."""
+    import os
     import shutil
 
     directory = pathlib.Path(directory)
@@ -47,7 +48,11 @@ def save_flat(
         w_shape=np.asarray(arr.shape, np.int64),
         meta=json.dumps(meta),
     )
-    shutil.copyfile(path, directory / f"{prefix}_latest.npz")
+    # Atomic `_latest` publish: a concurrent loader (resume, tester) must
+    # never see a half-copied file.
+    tmp = directory / f".{prefix}_latest.npz.tmp"
+    shutil.copyfile(path, tmp)
+    os.replace(tmp, directory / f"{prefix}_latest.npz")
     return path
 
 

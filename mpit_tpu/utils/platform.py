@@ -51,14 +51,12 @@ def ensure_cpu_device_headroom(n_mesh_devices: int, extra: int = CPU_POOL_HEADRO
     builders keep using only ``n_mesh_devices``.
 
     Must run before the jax backend initializes; harmless (ignored by
-    XLA) afterwards.  A no-op unless the selected platform is the host
-    CPU — on real TPU neither the flag nor the mesh cap applies.
+    XLA) afterwards.  Both knobs only ever affect the host-CPU platform:
+    the XLA flag is ignored by accelerator backends, and
+    :func:`default_devices` applies the ``MPIT_MESH_DEVICES`` cap only
+    when the resolved device pool is CPU — so calling this on a real-TPU
+    host cannot shrink the accelerator mesh.
     """
-    import jax
-
-    plat = os.environ.get("JAX_PLATFORMS") or jax.config.jax_platforms or ""
-    if not plat.split(",")[0].strip() == "cpu":
-        return
     flags = os.environ.get("XLA_FLAGS", "")
     os.environ["XLA_FLAGS"] = (
         flags + f" --xla_force_host_platform_device_count={n_mesh_devices + extra}"
@@ -68,12 +66,14 @@ def ensure_cpu_device_headroom(n_mesh_devices: int, extra: int = CPU_POOL_HEADRO
 
 def default_devices():
     """The device pool meshes should span: the first ``MPIT_MESH_DEVICES``
-    of ``jax.devices()`` when that env var is set (CPU-pool-headroom
-    convention above), else all devices."""
+    of ``jax.devices()`` when that env var is set *and* the pool is the
+    host-CPU platform (the headroom convention above only ever registers
+    extra CPU devices), else all devices — a stale cap can never shrink a
+    real accelerator mesh."""
     import jax
 
     devs = jax.devices()
     cap = os.environ.get("MPIT_MESH_DEVICES")
-    if cap:
+    if cap and devs and devs[0].platform == "cpu":
         devs = devs[: int(cap)]
     return devs

@@ -157,3 +157,16 @@ class TestZigzag:
     def test_zigzag_requires_causal(self, mesh):
         with pytest.raises(ValueError, match="causal"):
             ring_attention(mesh, causal=False, layout="zigzag")
+
+    def test_zigzag_rejects_odd_chunk(self, rng, mesh):
+        # The pre-permuted (permute_inputs=False) path must fail loudly at
+        # trace time on an odd per-device chunk, not silently drop a row.
+        n = mesh.shape["sp"]
+        L_odd = n * 3  # 3 per device: odd halves
+        q, k, v = _qkv(rng, shape=(1, L_odd, 2, 16))
+        ring = ring_attention(
+            mesh, causal=True, impl="jnp", layout="zigzag",
+            permute_inputs=False,
+        )
+        with pytest.raises(ValueError, match="even per-device chunk"):
+            ring(q, k, v)

@@ -114,6 +114,37 @@ class TestTcpTransport:
             pass
         np.testing.assert_array_equal(out, data)
 
+    def test_outbox_is_zero_copy_and_nonblocking(self, pair):
+        # A deep backlog must not snapshot payloads (O(1) transport-owned
+        # memory per queued message) and isend must stay nonblocking.
+        # Stall b's reader (its frame loop needs b._lock) so TCP
+        # backpressure provably retains entries in a's outbox.
+        a, b = pair
+        payload = np.arange(1 << 18, dtype=np.float32)  # 1 MiB each
+        with b._lock:
+            handles = [a.isend(payload, 1, 5) for _ in range(8)]
+            with a._out_cv[1]:
+                entries = list(a._outboxes[1])
+        assert entries, "outbox must retain entries while the peer stalls"
+        assert all(isinstance(e[2], memoryview) for e in entries)
+        outs = [np.zeros_like(payload) for _ in range(8)]
+        for out in outs:
+            b.recv(0, 5, out=out)
+        for h in handles:
+            while not a.test(h):
+                pass
+        for out in outs:
+            np.testing.assert_array_equal(out, payload)
+
+    def test_isend_to_dead_peer_cancels_and_raises_once(self, pair):
+        a, b = pair
+        a._drain_outbox(1, error="rank 1 connection lost")
+        h = a.isend(np.arange(4, dtype=np.float32), 1, 6)
+        assert h.cancelled and not h.done
+        with pytest.raises(RuntimeError, match="unreachable"):
+            a.test(h)
+        assert a.test(h) is False  # raise-once, then quiet not-done
+
     def test_close_cancels_queued_sends(self):
         """No orphaned handles: after close every send handle is done or
         cancelled (a blocking sender must not spin forever), and isend on
