@@ -29,6 +29,7 @@ from mpit_tpu.ops.flash_attention import (
     block_attention_partial,
     finalize_partials,
     flash_attention,
+    flash_attention_bwd_pair,
     flash_attention_partial,
     merge_partials,
 )
@@ -38,7 +39,8 @@ __all__ = [
     "fused_nesterov_commit", "fused_nesterov_commit_reference",
     "fused_adam", "fused_adam_reference",
     "fused_elastic", "fused_elastic_reference",
-    "flash_attention", "flash_attention_partial", "attention_reference",
+    "flash_attention", "flash_attention_partial", "flash_attention_bwd_pair",
+    "attention_reference",
     "block_attention_partial", "merge_partials", "finalize_partials",
     "as_rows", "from_rows",
 ]

@@ -19,8 +19,12 @@ showcase the rebuild adds on top of capability parity.  Design:
 - ``kv_len`` masks padded keys so inputs need not be block-multiples.
 
 :func:`flash_attention` is the user op (normalized output, custom VJP:
-backward recomputes via the jnp reference — O(Lq·Lk) per call, which in
-the ring layout is per-chunk, i.e. already blockwise).
+pallas backward in the standard flash schedule — P is recomputed
+blockwise from the saved row log-sum-exp, so backward peak memory is
+O(block_q·block_k) scratch, never the (Lq, Lk) score matrix).
+:func:`flash_attention_bwd_pair` exposes the same backward for one
+(Q chunk, KV chunk) pair — the per-ring-step op of
+:mod:`mpit_tpu.parallel.ring_attention`.
 :func:`block_attention_partial` returns unnormalized partials
 ``(acc, m, l)`` for cross-chunk merging; :func:`merge_partials` /
 :func:`finalize_partials` implement the log-sum-exp combine.
@@ -28,7 +32,6 @@ the ring layout is per-chunk, i.e. already blockwise).
 
 from __future__ import annotations
 
-import contextlib
 import functools
 import math
 from typing import Tuple
@@ -205,6 +208,23 @@ def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
             ).astype(o_ref.dtype)
 
 
+def _tile_dims(lq, lk, d, block_q, block_k, sm_scale):
+    """Shared forward/backward tiling contract: softmax scale, clamped
+    block sizes and padded dims.  The backward's saved-LSE rows only line
+    up with recomputed score tiles if both directions use exactly this."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    bq = min(block_q, _round_up(lq, 8))
+    bk = min(block_k, _round_up(lk, LANE))
+    return scale, bq, bk, _round_up(lq, bq), _round_up(lk, bk), _round_up(d, LANE)
+
+
+def _lse_of(m, l):
+    """Row log-sum-exp from (m, l) partials; -inf on all-masked (dead)
+    rows — the convention the backward kernels' ``exp(s - lse)`` safety
+    argument depends on."""
+    return m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+
+
 def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
            block_k, interpret, partial=False, precision=None):
     """Core call on (Lq, D) x (Lk, D); pads to tiles.  Returns the
@@ -212,10 +232,9 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
     ``(acc, m, l)`` triple (f32) for cross-chunk merging."""
     lq, d = q.shape
     lk = k.shape[0]
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    bq = min(block_q, _round_up(lq, 8))
-    bk = min(block_k, _round_up(lk, LANE))
-    lq_p, lk_p, d_p = _round_up(lq, bq), _round_up(lk, bk), _round_up(d, LANE)
+    scale, bq, bk, lq_p, lk_p, d_p = _tile_dims(
+        lq, lk, d, block_q, block_k, sm_scale
+    )
     qp = jnp.pad(q, ((0, lq_p - lq), (0, d_p - d)))
     kp = jnp.pad(k, ((0, lk_p - lk), (0, d_p - d)))
     vp = jnp.pad(v, ((0, lk_p - lk), (0, d_p - d)))
@@ -281,7 +300,8 @@ def flash_attention_partial(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Pallas twin of :func:`block_attention_partial`: unnormalized
     ``(acc, m, l)`` over ``(..., L, D)``.  Forward-only — ring attention
-    wraps it in a custom VJP at the ring level
+    pairs it with :func:`flash_attention_bwd_pair` under a custom VJP at
+    the ring level
     (:mod:`mpit_tpu.parallel.ring_attention`)."""
     f = lambda q2, k2, v2: _fa_2d(
         q2, k2, v2, q_offset, kv_offset, causal=causal, sm_scale=sm_scale,
@@ -293,10 +313,231 @@ def flash_attention_partial(
     return f(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# pallas backward kernels (standard flash-bwd schedule)
+#
+# Residuals from the forward are O (normalized output) and the row
+# log-sum-exp  LSE = m + log(l); the backward recomputes P blockwise as
+# exp(scale*QK^T - LSE) — never materializing the (Lq, Lk) score matrix —
+# and accumulates
+#     delta = rowsum(dO * O)
+#     dV    = P^T dO
+#     dS    = P * (dO V^T - delta)
+#     dQ    = scale * dS K          (kernel 1: grid (i, j), dQ_i in VMEM)
+#     dK    = scale * dS^T Q        (kernel 2: grid (j, i), dK_j/dV_j in VMEM)
+# Peak extra memory is one (block_q, block_k) tile per program — O(block).
+# ---------------------------------------------------------------------------
+
+
+def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+              qoff_ref, kvoff_ref, kvlen_ref, i, j, *,
+              causal, scale, block_q, block_k, precision):
+    """Shared block math: recompute P and dS for the (i, j) tile."""
+    qf = q_ref[:].astype(jnp.float32)
+    kf = k_ref[:].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        qf, kf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    ) * scale  # (block_q, block_k)
+
+    qi = (qoff_ref[0, 0] + i * block_q
+          + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+    kj_local = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = kj_local < kvlen_ref[0, 0]
+    if causal:
+        valid = valid & (qi >= kvoff_ref[0, 0] + kj_local)
+
+    # exp(s - lse) is only read where valid; all-masked rows have
+    # lse = -inf and no valid element, so the inf branch is never taken.
+    p = jnp.where(valid, jnp.exp(s - lse_ref[:, :1]), 0.0)
+    dof = do_ref[:].astype(jnp.float32)
+    dp = jax.lax.dot_general(
+        dof, v_ref[:].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    )  # (block_q, block_k)
+    ds = p * (dp - delta_ref[:, :1])
+    return p, ds, qf, dof
+
+
+def _fa_bwd_dq_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, do_ref,
+                      lse_ref, delta_ref, k_ref, v_ref, dq_ref, dq_scr, *,
+                      causal, scale, block_q, block_k, precision):
+    i, j = pl.program_id(0), pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = j * block_k < kvlen_ref[0, 0]
+    if causal:
+        q_max = qoff_ref[0, 0] + i * block_q + (block_q - 1)
+        k_min = kvoff_ref[0, 0] + j * block_k
+        live = jnp.logical_and(live, q_max >= k_min)
+
+    @pl.when(live)
+    def _block():
+        _, ds, _, _ = _bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qoff_ref, kvoff_ref, kvlen_ref, i, j,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            precision=precision,
+        )
+        dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
+            ds, k_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkdv_kernel(qoff_ref, kvoff_ref, kvlen_ref, k_ref, v_ref,
+                        q_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dk_scr, dv_scr, *,
+                        causal, scale, block_q, block_k, precision):
+    j, i = pl.program_id(0), pl.program_id(1)  # kv outer, q inner
+    ni = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = j * block_k < kvlen_ref[0, 0]
+    if causal:
+        q_max = qoff_ref[0, 0] + i * block_q + (block_q - 1)
+        k_min = kvoff_ref[0, 0] + j * block_k
+        live = jnp.logical_and(live, q_max >= k_min)
+
+    @pl.when(live)
+    def _block():
+        p, ds, qf, dof = _bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qoff_ref, kvoff_ref, kvlen_ref, i, j,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            precision=precision,
+        )
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, dof, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+            ds, qf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _rows_to_lanes(x, length_p):
+    """(L,) f32 row stats -> (L_p, LANE) with the value broadcast across
+    lanes (the layout the kernels read back as ``ref[:, :1]``)."""
+    xp = jnp.pad(x.astype(jnp.float32), (0, length_p - x.shape[0]))
+    return jnp.broadcast_to(xp[:, None], (length_p, LANE))
+
+
+def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
+               sm_scale, block_q, block_k, interpret, precision):
+    """Backward core on (Lq, D) x (Lk, D): returns (dq, dk, dv).
+
+    ``lse``/``delta`` are per-q-row f32 vectors (log-sum-exp from the
+    forward; rowsum(dO*O)).  Padded q rows carry dO = 0 so their P/dS
+    contribute nothing; padded k rows are masked by ``kv_len``.
+    """
+    lq, d = q.shape
+    lk = k.shape[0]
+    scale, bq, bk, lq_p, lk_p, d_p = _tile_dims(
+        lq, lk, d, block_q, block_k, sm_scale
+    )
+    qp = jnp.pad(q, ((0, lq_p - lq), (0, d_p - d)))
+    kp = jnp.pad(k, ((0, lk_p - lk), (0, d_p - d)))
+    vp = jnp.pad(v, ((0, lk_p - lk), (0, d_p - d)))
+    dop = jnp.pad(do, ((0, lq_p - lq), (0, d_p - d)))
+    lse_r = _rows_to_lanes(lse, lq_p)
+    delta_r = _rows_to_lanes(delta, lq_p)
+
+    sspec = pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+    scalars = (
+        jnp.asarray(q_offset, jnp.int32).reshape(1, 1),
+        jnp.asarray(kv_offset, jnp.int32).reshape(1, 1),
+        jnp.asarray(lk, jnp.int32).reshape(1, 1),
+    )
+    kw = dict(causal=causal, scale=scale, block_q=bq, block_k=bk,
+              precision=precision)
+    interp = _interpret(interpret)
+
+    # Kernel 1: dQ — q rows outer, kv blocks inner.
+    qrow = pl.BlockSpec((bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+    qstat = pl.BlockSpec((bq, LANE), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+    kvrow = pl.BlockSpec((bk, d_p), lambda i, j: (j, 0), memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, **kw),
+        grid=(lq_p // bq, lk_p // bk),
+        in_specs=[sspec, sspec, sspec, qrow, qrow, qstat, qstat, kvrow, kvrow],
+        out_specs=qrow,
+        out_shape=jax.ShapeDtypeStruct((lq_p, d_p), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
+        interpret=interp,
+    )(*scalars, qp, dop, lse_r, delta_r, kp, vp)
+
+    # Kernel 2: dK/dV — kv blocks outer, q rows inner.
+    kvrow2 = pl.BlockSpec((bk, d_p), lambda j, i: (j, 0), memory_space=pltpu.VMEM)
+    qrow2 = pl.BlockSpec((bq, d_p), lambda j, i: (i, 0), memory_space=pltpu.VMEM)
+    qstat2 = pl.BlockSpec((bq, LANE), lambda j, i: (i, 0), memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkdv_kernel, **kw),
+        grid=(lk_p // bk, lq_p // bq),
+        in_specs=[sspec, sspec, sspec, kvrow2, kvrow2, qrow2, qrow2,
+                  qstat2, qstat2],
+        out_specs=(kvrow2, kvrow2),
+        out_shape=(
+            jax.ShapeDtypeStruct((lk_p, d_p), k.dtype),
+            jax.ShapeDtypeStruct((lk_p, d_p), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, d_p), jnp.float32),
+            pltpu.VMEM((bk, d_p), jnp.float32),
+        ],
+        interpret=interp,
+    )(*scalars, kp, vp, qp, dop, lse_r, delta_r)
+
+    return dq[:lq, :d], dk[:lk, :d], dv[:lk, :d]
+
+
+def flash_attention_bwd_pair(q, k, v, do, lse, *, causal=False, sm_scale=None,
+                             q_offset=0, kv_offset=0, delta=None, o=None,
+                             block_q=256, block_k=512, interpret=None,
+                             precision=None):
+    """Pallas flash backward for one (Q chunk, KV chunk) pair over
+    ``(..., L, D)``: returns ``(dq, dk, dv)`` given the forward's row
+    ``lse`` (shape ``(..., Lq)``) and either ``delta = rowsum(dO*O)`` or
+    ``o`` to compute it from.  This is the per-ring-step backward op of
+    :mod:`mpit_tpu.parallel.ring_attention` — O(block) extra memory.
+    """
+    if delta is None:
+        if o is None:
+            raise ValueError("flash_attention_bwd_pair needs delta or o")
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    f = lambda q2, k2, v2, do2, lse2, delta2: _fa_2d_bwd(
+        q2, k2, v2, do2, lse2, delta2, q_offset, kv_offset, causal=causal,
+        sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        interpret=interpret, precision=precision,
+    )
+    for _ in range(q.ndim - 2):
+        f = jax.vmap(f)
+    return f(q, k, v, do, lse, delta)
+
+
 @functools.lru_cache(maxsize=64)
 def _make_flash(causal, sm_scale, block_q, block_k, interpret, precision):
     """Differentiable flash op for fixed static config: pallas forward,
-    recompute-backward through the jnp reference."""
+    pallas backward (flash schedule, O(block) memory — the forward's
+    partial outputs provide the LSE residual)."""
 
     @jax.custom_vjp
     def fa(q, k, v, q_offset, kv_offset):
@@ -310,21 +551,23 @@ def _make_flash(causal, sm_scale, block_q, block_k, interpret, precision):
         return f(q, k, v)
 
     def fwd(q, k, v, q_offset, kv_offset):
-        return fa(q, k, v, q_offset, kv_offset), (q, k, v, q_offset, kv_offset)
+        acc, m, l = flash_attention_partial(
+            q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset,
+            kv_offset=kv_offset, block_q=block_q, block_k=block_k,
+            interpret=interpret, precision=precision,
+        )
+        o = finalize_partials(acc, l, dtype=q.dtype)
+        lse = _lse_of(m, l)
+        return o, (q, k, v, o, lse, q_offset, kv_offset)
 
     def bwd(res, g):
-        q, k, v, q_offset, kv_offset = res
-        ref = functools.partial(
-            attention_reference, causal=causal, sm_scale=sm_scale,
-            q_offset=q_offset, kv_offset=kv_offset,
+        q, k, v, o, lse, q_offset, kv_offset = res
+        dq, dk, dv = flash_attention_bwd_pair(
+            q, k, v, g, lse, causal=causal, sm_scale=sm_scale,
+            q_offset=q_offset, kv_offset=kv_offset, o=o,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            precision=precision,
         )
-        # Match the forward's matmul precision in the recompute so the
-        # knob governs both directions.
-        ctx = (jax.default_matmul_precision(precision) if precision
-               else contextlib.nullcontext())
-        with ctx:
-            _, vjp = jax.vjp(ref, q, k, v)
-            dq, dk, dv = vjp(g.astype(q.dtype))
         return dq, dk, dv, None, None
 
     fa.defvjp(fwd, bwd)

@@ -21,8 +21,11 @@ layer uses (:func:`mpit_tpu.parallel.collective.ring_shift`):
 
 Two block implementations: ``jnp`` (differentiable end-to-end; XLA fuses
 the blockwise math) and ``pallas`` (the flash kernel emitting partials;
-forward wrapped in a custom VJP whose backward recomputes through the
-jnp ring — per-chunk blockwise memory, no O(L²) materialization).
+forward wrapped in a custom VJP whose backward is a second ring over the
+pallas flash-backward pair kernels — (dk, dv) accumulators ride the KV
+rotation, P is re-derived blockwise from the saved row log-sum-exp, so
+backward memory is O(block) scratch per pair, never an (L, L) or even
+per-chunk (C, C) score matrix).
 
 Causal ring attention has two layouts: ``contiguous`` (every device
 computes all n steps, most of them fully masked on low-rank devices) and
@@ -43,8 +46,10 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpit_tpu.ops.flash_attention import (
+    _lse_of,
     block_attention_partial,
     finalize_partials,
+    flash_attention_bwd_pair,
     flash_attention_partial,
     merge_partials,
 )
@@ -58,8 +63,10 @@ def sp_mesh(devices: Sequence[jax.Device] | None = None, axis: str = "sp") -> Me
     return Mesh(np.array(devs), (axis,))
 
 
-def _ring_chunks(q, k, v, *, axis, n, partial_fn):
-    """Shared ring loop: local (B, H, C, D) chunks, returns (B, H, C, D).
+def _ring_chunks(q, k, v, *, axis, n, partial_fn, with_lse=False):
+    """Shared ring loop: local (B, H, C, D) chunks, returns (B, H, C, D)
+    (with ``with_lse``: also the (B, H, C) row log-sum-exp residual the
+    flash backward needs).
 
     ``partial_fn(q, k, v, q_offset, kv_offset) -> (acc, m, l)``.
     """
@@ -81,10 +88,51 @@ def _ring_chunks(q, k, v, *, axis, n, partial_fn):
         if s + 1 < n:
             kb = jax.lax.ppermute(kb, axis, perm)
             vb = jax.lax.ppermute(vb, axis, perm)
-    return finalize_partials(acc, l, dtype=q.dtype)
+    out = finalize_partials(acc, l, dtype=q.dtype)
+    return (out, _lse_of(m, l)) if with_lse else out
 
 
-def _ring_chunks_zigzag(q, k, v, *, axis, n, partial_fn):
+def _ring_bwd_chunks(q, k, v, do, o, lse, *, axis, n, pair_bwd):
+    """Backward ring for the contiguous layout.
+
+    ``pair_bwd(q, k, v, do, lse, delta, q_offset, kv_offset) ->
+    (dq, dk, dv)`` is the per-pair flash backward.  KV chunks rotate
+    around the ring *together with* their accumulated (dk, dv); after the
+    n-th visit one final hop delivers each chunk's gradient back to its
+    owner.  dq accumulates locally.  Peak memory per device: the local
+    chunks plus one rotating (k, v, dk, dv) set — O(L/n), matching the
+    forward."""
+    my = jax.lax.axis_index(axis)
+    chunk = q.shape[-2]
+    q_off = my * chunk
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+
+    kb, vb = k, v
+    for s in range(n):
+        owner = (my + (n - s)) % n
+        dqi, dki, dvi = pair_bwd(q, kb, vb, do, lse, delta, q_off,
+                                 owner * chunk)
+        dq = dq + dqi.astype(jnp.float32)
+        dk = dk + dki.astype(jnp.float32)
+        dv = dv + dvi.astype(jnp.float32)
+        if s + 1 < n:
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            dk = jax.lax.ppermute(dk, axis, perm)
+            dv = jax.lax.ppermute(dv, axis, perm)
+    # The chunk in hand after the loop belongs to (my+1)%n: one final hop
+    # brings every accumulated (dk, dv) home.
+    dk = jax.lax.ppermute(dk, axis, perm)
+    dv = jax.lax.ppermute(dv, axis, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _ring_chunks_zigzag(q, k, v, *, axis, n, partial_fn, with_lse=False):
     """Load-balanced causal ring: each device holds TWO half-chunks of the
     zigzag layout — global chunk ``d`` and chunk ``2n-1-d`` — so causal
     useful work is ~2 half-blocks per device per step instead of the
@@ -158,7 +206,84 @@ def _ring_chunks_zigzag(q, k, v, *, axis, n, partial_fn):
     outs = [
         finalize_partials(acc, l, dtype=q.dtype) for (acc, _m, l) in parts
     ]
-    return jnp.concatenate(outs, axis=-2)
+    out = jnp.concatenate(outs, axis=-2)
+    if with_lse:
+        lse = jnp.concatenate(
+            [_lse_of(m, l) for (_acc, m, l) in parts], axis=-1
+        )
+        return out, lse
+    return out
+
+
+def _ring_bwd_chunks_zigzag(q, k, v, do, o, lse, *, axis, n, pair_bwd):
+    """Backward ring for the zigzag layout: same two-half decomposition
+    and static/dynamic pair liveness as the forward (see
+    :func:`_ring_chunks_zigzag`), with (dk, dv) riding the KV rotation
+    exactly as in :func:`_ring_bwd_chunks`."""
+    my = jax.lax.axis_index(axis)
+    c = q.shape[-2] // 2
+    q_halves = (q[..., :c, :], q[..., c:, :])
+    do_halves = (do[..., :c, :], do[..., c:, :])
+    lse_halves = (lse[..., :c], lse[..., c:])
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    delta_halves = (delta[..., :c], delta[..., c:])
+    q_offs = (my * c, (2 * n - 1 - my) * c)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq_halves = [jnp.zeros(qh.shape, jnp.float32) for qh in q_halves]
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+
+    kb, vb = k, v
+    for s in range(n):
+        owner = (my + (n - s)) % n
+        kv_halves = (
+            (kb[..., :c, :], vb[..., :c, :]),
+            (kb[..., c:, :], vb[..., c:, :]),
+        )
+        kv_offs = (owner * c, (2 * n - 1 - owner) * c)
+
+        def pair(qi, ki):
+            return pair_bwd(
+                q_halves[qi], kv_halves[ki][0], kv_halves[ki][1],
+                do_halves[qi], lse_halves[qi], delta_halves[qi],
+                q_offs[qi], kv_offs[ki],
+            )
+
+        def zeros(qi, ki):
+            return lambda: (
+                jnp.zeros(q_halves[qi].shape, q.dtype),
+                jnp.zeros(kv_halves[ki][0].shape, k.dtype),
+                jnp.zeros(kv_halves[ki][1].shape, v.dtype),
+            )
+
+        def add(qi, ki, grads):
+            dqi, dki, dvi = grads
+            dq_halves[qi] = dq_halves[qi] + dqi.astype(jnp.float32)
+            lo, hi = (0, c) if ki == 0 else (c, 2 * c)
+            return (
+                dk.at[..., lo:hi, :].add(dki.astype(jnp.float32)),
+                dv.at[..., lo:hi, :].add(dvi.astype(jnp.float32)),
+            )
+
+        # (late_q, early_kv): statically live.
+        dk, dv = add(1, 0, pair(1, 0))
+        # (early_q, early_kv): live iff my >= owner.
+        dk, dv = add(0, 0, jax.lax.cond(
+            my >= owner, lambda: pair(0, 0), zeros(0, 0)))
+        # (late_q, late_kv): live iff owner >= my.
+        dk, dv = add(1, 1, jax.lax.cond(
+            owner >= my, lambda: pair(1, 1), zeros(1, 1)))
+        # (early_q, late_kv): statically dead — skipped.
+        if s + 1 < n:
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            dk = jax.lax.ppermute(dk, axis, perm)
+            dv = jax.lax.ppermute(dv, axis, perm)
+    dk = jax.lax.ppermute(dk, axis, perm)
+    dv = jax.lax.ppermute(dv, axis, perm)
+    dq = jnp.concatenate(dq_halves, axis=-2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def zigzag_order(n: int):
@@ -216,13 +341,35 @@ def _ring_jnp(q, k, v, *, axis, n, causal, sm_scale, precision=None,
 
 
 def _ring_pallas(q, k, v, *, axis, n, causal, sm_scale, block_q, block_k,
-                 interpret, precision, layout="contiguous"):
+                 interpret, precision, layout="contiguous", with_lse=False):
     fn = lambda q2, k2, v2, qo, ko: flash_attention_partial(
         q2, k2, v2, causal=causal, sm_scale=sm_scale, q_offset=qo,
         kv_offset=ko, block_q=block_q, block_k=block_k, interpret=interpret,
         precision=precision,
     )
-    return _RING_LOOPS[layout](q, k, v, axis=axis, n=n, partial_fn=fn)
+    return _RING_LOOPS[layout](
+        q, k, v, axis=axis, n=n, partial_fn=fn, with_lse=with_lse
+    )
+
+
+_RING_BWD_LOOPS = {
+    "contiguous": _ring_bwd_chunks, "zigzag": _ring_bwd_chunks_zigzag,
+}
+
+
+def _ring_pallas_bwd(q, k, v, do, o, lse, *, axis, n, causal, sm_scale,
+                     block_q, block_k, interpret, precision,
+                     layout="contiguous"):
+    fn = lambda q2, k2, v2, do2, lse2, delta2, qo, ko: (
+        flash_attention_bwd_pair(
+            q2, k2, v2, do2, lse2, delta=delta2, causal=causal,
+            sm_scale=sm_scale, q_offset=qo, kv_offset=ko, block_q=block_q,
+            block_k=block_k, interpret=interpret, precision=precision,
+        )
+    )
+    return _RING_BWD_LOOPS[layout](
+        q, k, v, do, o, lse, axis=axis, n=n, pair_bwd=fn
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -235,25 +382,27 @@ def _make_local_fn(axis, n, causal, sm_scale, impl, block_q, block_k,
     if impl == "jnp":
         return jnp_fn
 
-    pallas_fwd = functools.partial(
-        _ring_pallas, axis=axis, n=n, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
-        precision=precision, layout=layout,
+    cfg = dict(
+        axis=axis, n=n, causal=causal, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, interpret=interpret, precision=precision,
+        layout=layout,
     )
+    pallas_fwd = functools.partial(_ring_pallas, **cfg)
 
     @jax.custom_vjp
     def fn(q, k, v):
         return pallas_fwd(q, k, v)
 
     def fwd(q, k, v):
-        return pallas_fwd(q, k, v), (q, k, v)
+        # One forward with the LSE residual kept: the backward ring then
+        # needs no O(C^2) recompute — each pair re-derives P blockwise
+        # inside the pallas backward kernels.
+        out, lse = pallas_fwd(q, k, v, with_lse=True)
+        return out, (q, k, v, out, lse)
 
     def bwd(res, g):
-        q, k, v = res
-        # jnp_fn already carries the precision context, so the recompute
-        # matches the forward's matmul precision.
-        _, vjp = jax.vjp(jnp_fn, q, k, v)
-        return vjp(g.astype(q.dtype))
+        q, k, v, o, lse = res
+        return _ring_pallas_bwd(q, k, v, g.astype(q.dtype), o, lse, **cfg)
 
     fn.defvjp(fwd, bwd)
     return fn
