@@ -39,12 +39,13 @@ from mpit_tpu.ops.tiles import (
 def fused_enabled(flag: bool | None = None) -> bool:
     """Should a caller route through the fused kernels?  Resolution:
     explicit flag > MPIT_FUSED env (``1``/``0``) > on-TPU default.
-    An explicit flag wins over the env because call sites use False as a
-    hard constraint (the mesh trainers force it off inside sharded jits,
-    where a pallas call can't be auto-partitioned); the env is a
-    preference for the unconstrained (None) sites.  Off-TPU the kernels
-    run interpreted — correct but slower than XLA's own fusion, hence
-    the default."""
+    An explicit flag wins over the env because call sites use it as a
+    hard constraint (e.g. tests pinning one path for trajectory
+    comparison); the env is a preference for the unconstrained (None)
+    sites.  The mesh trainers route through the shard_map bridge
+    (:mod:`mpit_tpu.parallel.fused`), which runs the sweep per device
+    tile.  Off-TPU the kernels run interpreted — correct but slower than
+    XLA's own fusion, hence the default."""
     if flag is not None:
         return bool(flag)
     env = os.environ.get("MPIT_FUSED")
@@ -77,20 +78,31 @@ def _row_spec(block_rows: int):
 # ---------------------------------------------------------------------------
 
 
-def _nesterov_kernel(clr_ref, w_ref, vt_ref, g_ref, w_out, vt_out, *, l2wd):
+def _nesterov_kernel(clr_ref, w_ref, vt_ref, g_ref, *rest, l2wd, retract):
+    if retract:
+        sug_ref, w_out, vt_out = rest
+    else:
+        w_out, vt_out = rest
     g = g_ref[:]
     if l2wd != 0.0:
         g = g + l2wd * w_ref[:]
     step = clr_ref[0, 0] * g
-    w_out[:] = w_ref[:] - step
+    w = w_ref[:] - step
+    if retract:
+        w = w - sug_ref[:]
+    w_out[:] = w
     vt_out[:] = vt_ref[:] - step
 
 
-def fused_nesterov_commit_reference(w, vt, g, clr, *, l2wd: float = 0.0):
+def fused_nesterov_commit_reference(w, vt, g, clr, *, l2wd: float = 0.0,
+                                    sug=None):
     if l2wd != 0.0:
         g = g + l2wd * w
     step = jnp.asarray(clr, w.dtype) * g
-    return w - step, vt - step
+    w_new = w - step
+    if sug is not None:
+        w_new = w_new - sug
+    return w_new, vt - step
 
 
 def fused_nesterov_commit(
@@ -100,20 +112,32 @@ def fused_nesterov_commit(
     clr,
     *,
     l2wd: float = 0.0,
+    sug: jnp.ndarray | None = None,
     interpret: bool | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One-sweep msgd commit: ``(w - clr*g_eff, vt - clr*g_eff)`` where
-    ``g_eff = g + l2wd*w``.  ``clr`` may be a traced scalar (decayed lr)."""
+    ``g_eff = g + l2wd*w``.  ``clr`` may be a traced scalar (decayed lr).
+
+    With ``sug`` the elastic retract of the EASGD sync round rides the
+    same sweep — ``w - clr*g_eff - sug`` — so commit + retract cost one
+    HBM pass instead of two (reference optim-eamsgd.lua:66 applies the
+    retract right after its localupdate)."""
     n = w.shape[0]
     br = block_rows_for(n)
     w2, _ = as_rows(w, br)
     vt2, _ = as_rows(vt, br)
     g2, _ = as_rows(g, br)
     grid = (w2.shape[0] // br,)
+    retract = sug is not None
+    operands = [_scalar(clr, w2.dtype), w2, vt2, g2]
+    in_specs = [_scalar_spec(), _row_spec(br), _row_spec(br), _row_spec(br)]
+    if retract:
+        operands.append(as_rows(sug, br)[0])
+        in_specs.append(_row_spec(br))
     w_new, vt_new = pl.pallas_call(
-        functools.partial(_nesterov_kernel, l2wd=float(l2wd)),
+        functools.partial(_nesterov_kernel, l2wd=float(l2wd), retract=retract),
         grid=grid,
-        in_specs=[_scalar_spec(), _row_spec(br), _row_spec(br), _row_spec(br)],
+        in_specs=in_specs,
         out_specs=(_row_spec(br), _row_spec(br)),
         out_shape=(
             jax.ShapeDtypeStruct(w2.shape, w2.dtype),
@@ -121,7 +145,7 @@ def fused_nesterov_commit(
         ),
         input_output_aliases={1: 0, 2: 1},
         interpret=_interpret(interpret),
-    )(_scalar(clr, w2.dtype), w2, vt2, g2)
+    )(*operands)
     return from_rows(w_new, n), from_rows(vt_new, n)
 
 

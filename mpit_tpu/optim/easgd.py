@@ -68,6 +68,15 @@ class EAMSGD:
         self._localupdate = jax.jit(_localupdate)
         self._elastic = jax.jit(lambda w, center: self.mva * (w - center))
         self._retract = jax.jit(lambda w, sug: w - sug)
+        # Comm-only mode (lr == 0, reference :25): force and retract are
+        # adjacent — no local update between — so both ride one fused HBM
+        # sweep (ops.fused_update.fused_elastic) when enabled.
+        from mpit_tpu.ops.fused_update import fused_elastic, fused_enabled
+
+        self._use_fused_elastic = self._skip_local and fused_enabled(None)
+        self._elastic_retract = jax.jit(
+            lambda w, center: fused_elastic(w, center, self.mva)
+        )
 
     @property
     def k(self) -> int:
@@ -88,12 +97,19 @@ class EAMSGD:
     def step(self, w: jnp.ndarray, *fn_args: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
         assert self._started, "call start(w) first"
         sync_round = self._steps % self.su == 0
+        w_retracted = None
         if sync_round:
             self.pc.async_recv_param()  # center_host <- w*
             t0 = time.monotonic()
             self.pc.wait()  # completes this recv and any prior send
             self.dusync += time.monotonic() - t0
-            sug = self._elastic(w, jnp.asarray(self.center_host))
+            if self._use_fused_elastic:
+                # One sweep computes sug and the retracted w together.
+                w_retracted, sug = self._elastic_retract(
+                    w, jnp.asarray(self.center_host)
+                )
+            else:
+                sug = self._elastic(w, jnp.asarray(self.center_host))
             np.copyto(self.sug_host, np.asarray(sug))
             self.pc.async_send_grad()  # server: w* += sug
             t0 = time.monotonic()
@@ -107,7 +123,9 @@ class EAMSGD:
             self._steps += 1
 
         if sync_round:
-            w = self._retract(w, sug)  # w -= mva*(w - w*) (reference :66)
+            # w -= mva*(w - w*) (reference :66) — precomputed by the fused
+            # sweep in comm-only mode, where no local update intervened.
+            w = w_retracted if w_retracted is not None else self._retract(w, sug)
         return w, loss
 
     def stop(self) -> None:

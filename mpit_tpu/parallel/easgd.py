@@ -40,7 +40,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mpit_tpu.optim.msgd import MSGDConfig, msgd_commit, msgd_lookahead
+from mpit_tpu.ops.fused_update import fused_enabled
+from mpit_tpu.optim.msgd import (
+    MSGDConfig,
+    _effective_lr,
+    msgd_commit,
+    msgd_lookahead,
+)
+from mpit_tpu.parallel.fused import mesh_fused_commit
 from mpit_tpu.parallel.mesh import put_global, put_local
 
 
@@ -64,43 +71,76 @@ class MeshEASGD:
         if not (su > 0 and mva > 0):
             raise ValueError("easgd requires su>0 and mva>0 (reference :86)")
         self.mesh = mesh
-        # Force the plain-XLA commit: inside this sharded jit a pallas
-        # call can't be auto-partitioned over the mesh (the fused sweep is
-        # for single-device flat vectors; here XLA fuses the update into
-        # the program anyway).
-        cfg = cfg._replace(use_fused=False)
         self.cfg = cfg
         self.mva = float(mva)
         self.su = int(su)
         self.n_dp = mesh.shape["dp"]
         self._steps = 0
+        # Fused pallas commit: a pallas call can't be auto-partitioned by
+        # the sharded jit, but shard_map runs the 1-D sweep on each
+        # device's own (worker-row, shard) tile (parallel/fused.py).  The
+        # kernel always folds the velocity update, so it needs mom > 0.
+        use_fused = cfg.mom > 0 and fused_enabled(cfg.use_fused)
+        self._use_fused = use_fused
+        cfg_inner = cfg._replace(use_fused=False)  # vmapped halves stay XLA
 
         ws = NamedSharding(mesh, P("dp", "shard"))   # per-worker param rows
         ks = NamedSharding(mesh, P("dp"))            # per-worker counters
         cs = NamedSharding(mesh, P("shard"))         # center shards
         bs = NamedSharding(mesh, P("dp"))            # per-worker batches
-        rep = NamedSharding(mesh, P())
         self._shardings = {"w": ws, "k": ks, "center": cs, "batch": bs}
 
-        def _one_local(w_i, vt_i, k_i, *args):
-            st = {"k": k_i, "vt": vt_i}
-            w_la, st = msgd_lookahead(w_i, st, cfg)
-            loss, grad = value_and_grad_fn(w_la, *args)
-            w_n, st = msgd_commit(w_la, grad, st, cfg)
-            return w_n, st["vt"], st["k"], loss
+        if use_fused:
+            fused_local = mesh_fused_commit(
+                mesh, P("dp", "shard"), P("dp"), l2wd=cfg.l2wd
+            )
+            fused_sync = mesh_fused_commit(
+                mesh, P("dp", "shard"), P("dp"), l2wd=cfg.l2wd, retract=True
+            )
+
+        def _grads(w, vt, k, *args):
+            def _one(w_i, vt_i, k_i, *a):
+                st = {"k": k_i, "vt": vt_i}
+                w_la, st = msgd_lookahead(w_i, st, cfg_inner)
+                loss, grad = value_and_grad_fn(w_la, *a)
+                return w_la, st["vt"], grad, loss
+
+            return jax.vmap(_one)(w, vt, k, *args)
+
+        def _commit(w_la, vt, g, k, sug=None):
+            if use_fused:
+                clr = jax.vmap(lambda ki: _effective_lr(cfg, ki))(k)
+                if sug is not None:
+                    return fused_sync(w_la, vt, g, clr, sug)
+                return fused_local(w_la, vt, g, clr)
+
+            def _c(w_i, g_i, vt_i, k_i, *s):
+                w2, st = msgd_commit(
+                    w_i, g_i, {"k": k_i, "vt": vt_i}, cfg_inner
+                )
+                if s:  # elastic retract after the local update (ref :66)
+                    w2 = w2 - s[0]
+                return w2, st["vt"]
+
+            if sug is not None:
+                return jax.vmap(_c)(w_la, g, vt, k, sug)
+            return jax.vmap(_c)(w_la, g, vt, k)
 
         def _local(w, vt, k, *args):
-            return jax.vmap(_one_local)(w, vt, k, *args)
+            w_la, vt2, g, loss = _grads(w, vt, k, *args)
+            w_n, vt_n = _commit(w_la, vt2, g, k)
+            return w_n, vt_n, k + 1, loss
 
         def _step_sync(w, vt, k, center, *args):
             # Sync round: pull+push around the local update, same ordering
             # as the reference (elastic delta uses pre-update w,
-            # optim-eamsgd.lua:54-61; retract after localupdate, :66).
+            # optim-eamsgd.lua:54-61; retract after localupdate, :66 —
+            # the retract rides the fused commit sweep when enabled).
             sug = self.mva * (w - center[None, :])  # every worker's push
             new_center = center + jnp.sum(sug, axis=0)
-            w, vt, k, loss = _local(w, vt, k, *args)
-            w = w - sug
-            return w, vt, k, new_center, loss
+            w_la, vt2, g, loss = _grads(w, vt, k, *args)
+            w_n, vt_n = _commit(w_la, vt2, g, k, sug)
+            return w_n, vt_n, k + 1, new_center, loss
 
         self._local_jit = jax.jit(
             _local,

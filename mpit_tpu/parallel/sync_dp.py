@@ -19,7 +19,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mpit_tpu.optim.msgd import MSGDConfig, msgd_commit, msgd_init, msgd_lookahead
+from mpit_tpu.ops.fused_update import fused_enabled
+from mpit_tpu.optim.msgd import (
+    MSGDConfig,
+    _effective_lr,
+    msgd_commit,
+    msgd_init,
+    msgd_lookahead,
+)
+from mpit_tpu.parallel.fused import mesh_fused_commit
 from mpit_tpu.parallel.mesh import put_global, put_local
 
 
@@ -38,20 +46,28 @@ class SyncDataParallel:
         cfg: MSGDConfig,
     ):
         self.mesh = mesh
-        # Plain-XLA commit: a pallas call can't be auto-partitioned over
-        # the mesh inside this sharded jit (see easgd.py).
-        cfg = cfg._replace(use_fused=False)
         self.cfg = cfg
+        # Fused pallas commit via shard_map over the 1-D shard slices
+        # (parallel/fused.py); the kernel folds the velocity update, so
+        # it needs mom > 0.
+        use_fused = cfg.mom > 0 and fused_enabled(cfg.use_fused)
+        self._use_fused = use_fused
+        cfg_inner = cfg._replace(use_fused=False)
         ps = NamedSharding(mesh, P("shard"))  # 1-D param/state sharding
         bs = NamedSharding(mesh, P("dp"))     # batch rows over workers
         self._param_sharding = ps
         self._batch_sharding = bs
+        if use_fused:
+            fused = mesh_fused_commit(mesh, P("shard"), P(), l2wd=cfg.l2wd)
 
         def _step(w, vt, k, xb, yb):
             st = {"k": k, "vt": vt}
-            w_la, st = msgd_lookahead(w, st, cfg)
+            w_la, st = msgd_lookahead(w, st, cfg_inner)
             loss, grad = value_and_grad_fn(w_la, xb, yb)
-            w_n, st = msgd_commit(w_la, grad, st, cfg)
+            if use_fused:
+                w_n, vt_n = fused(w_la, st["vt"], grad, _effective_lr(cfg, k))
+                return w_n, vt_n, k + 1, loss
+            w_n, st = msgd_commit(w_la, grad, st, cfg_inner)
             return w_n, st["vt"], st["k"], loss
 
         self._step_jit = jax.jit(

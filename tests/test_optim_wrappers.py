@@ -186,6 +186,23 @@ class TestEAMSGD:
         with pytest.raises(ValueError):
             EAMSGD(quadratic_vgf, FakeClient(), lr=0.1, mva=0.0, su=1)
 
+    def test_comm_only_fused_elastic_matches(self, w0, target, monkeypatch):
+        """lr=0 (comm-only, reference :25): the fused one-sweep
+        force+retract matches the two-op path."""
+        finals = {}
+        for env in ("0", "1"):
+            monkeypatch.setenv("MPIT_FUSED", env)
+            pc = FakeClient()
+            opt = EAMSGD(quadratic_vgf, pc, lr=0.0, mva=0.3, su=1)
+            assert opt._use_fused_elastic is (env == "1")
+            w = opt.start(jnp.asarray(w0))
+            for _ in range(3):
+                w, _ = opt.step(w, target)
+            opt.pc.wait()
+            finals[env] = (np.asarray(w), pc.center.copy())
+        np.testing.assert_allclose(finals["1"][0], finals["0"][0], atol=1e-6)
+        np.testing.assert_allclose(finals["1"][1], finals["0"][1], atol=1e-6)
+
 
 class TestRuleShell:
     def test_global_su1_ships_raw_grads(self, w0, target):

@@ -136,6 +136,31 @@ class TestMeshEASGD:
         state, _ = tr.step(state, *batches)   # step 4: local only
         np.testing.assert_array_equal(np.asarray(state["center"]), c1)
 
+    def test_fused_commit_matches_xla(self, mesh):
+        """use_fused=True (shard_map'd pallas sweep, retract riding the
+        commit on sync rounds) reproduces the plain-XLA trajectory."""
+        P_ = 300  # not a tile multiple: exercises the flat-vector padding
+        n_dp = mesh.shape["dp"]
+        target = jnp.linspace(-1, 1, P_)
+        xb = jnp.zeros((n_dp, 2, 1)); yb = jnp.zeros((n_dp, 2), jnp.int32)
+        states = {}
+        for fused in (False, True):
+            cfg = MSGDConfig(lr=0.1, mom=0.6, l2wd=1e-3, lrd=0.01, lrp=1.0,
+                             use_fused=fused)
+            tr = MeshEASGD(mesh, _quadratic_vgf(target), cfg,
+                           mva=0.5 / n_dp, su=2)
+            assert tr._use_fused is fused
+            state = tr.init(jnp.ones((P_,)))
+            batches = tr.shard_batch(xb, yb)
+            for _ in range(5):
+                state, _ = tr.step(state, *batches)
+            states[fused] = state
+        for key in ("w", "vt", "center"):
+            np.testing.assert_allclose(
+                np.asarray(states[True][key]), np.asarray(states[False][key]),
+                atol=1e-6, err_msg=key,
+            )
+
     def test_workers_converge_to_target(self, mesh):
         P_ = 16
         n_dp = mesh.shape["dp"]
@@ -183,3 +208,34 @@ class TestSyncDataParallel:
             w, ref_loss = ref.step(w, x, y)
         np.testing.assert_allclose(np.asarray(state["w"]), np.asarray(w), atol=1e-5)
         np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+
+    def test_fused_commit_matches_xla(self, mesh):
+        """The shard_map'd fused commit over 1-D shard slices reproduces
+        the plain-XLA sync-DP trajectory."""
+        rng = jax.random.PRNGKey(1)
+        module = MnistMLP(hidden=16)
+        x = jax.random.normal(rng, (8, 64))
+        y = jnp.arange(8) % 10
+        flat = flatten_module(module, rng, x[:2])
+
+        def vgf(w, xb, yb):
+            def loss_fn(w):
+                logp = flat.apply_flat(w, xb)
+                return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1))
+            return jax.value_and_grad(loss_fn)(w)
+
+        finals = {}
+        for fused in (False, True):
+            cfg = MSGDConfig(lr=0.1, mom=0.9, l2wd=1e-4, use_fused=fused)
+            tr = SyncDataParallel(mesh, vgf, cfg)
+            assert tr._use_fused is fused
+            state = tr.init(flat.w0)
+            xb, yb = tr.shard_batch(x, y)
+            for _ in range(3):
+                state, _ = tr.step(state, xb, yb)
+            finals[fused] = state
+        for key in ("w", "vt"):
+            np.testing.assert_allclose(
+                np.asarray(finals[True][key]), np.asarray(finals[False][key]),
+                atol=1e-6, err_msg=key,
+            )
