@@ -47,7 +47,34 @@ BICNN_LAUNCH_DEFAULTS = BICNN_DEFAULTS.merged(
     np=1,
     ring_mb=64,
     namespace="",
+    # Canonical tester surface shared with train.launch: none|first|last.
+    # The reference-parity booleans (testerfirst/testerlast,
+    # plaunch.lua:10-12) remain as aliases; setting both surfaces
+    # inconsistently is an error.
+    tester="",
 )
+
+
+def resolve_tester_flags(cfg: Config) -> tuple[bool, bool]:
+    """Unify the two tester dialects into (testerfirst, testerlast).
+
+    ``tester=none|first|last`` (the :mod:`mpit_tpu.train.launch` surface)
+    wins when set; the plaunch-parity booleans are aliases.  A conflict
+    between the two surfaces raises rather than silently preferring one.
+    """
+    t = str(cfg.get("tester", "") or "").strip().lower()
+    tf, tl = bool(cfg.get("testerfirst", False)), bool(cfg.get("testerlast", False))
+    if not t:
+        return tf, tl
+    if t not in ("none", "first", "last"):
+        raise ValueError(f"tester must be none|first|last, got {t!r}")
+    want = (t == "first", t == "last")
+    if (tf or tl) and (tf, tl) != want:
+        raise ValueError(
+            f"conflicting tester config: tester={t!r} vs "
+            f"testerfirst={tf} testerlast={tl}"
+        )
+    return want
 
 
 def assign_roles(
@@ -131,9 +158,10 @@ def run_rank(
             )
         trainer = BiCNNTrainer(cfg, None, data, rank)
         return {"role": "local", **trainer.run()}
+    testerfirst, testerlast = resolve_tester_flags(cfg)
     sranks, cranks, tester_rank, tranks = assign_roles(
-        effective, int(cfg.master_freq), bool(cfg.testerfirst),
-        bool(cfg.testerlast), str(cfg.valid_mode),
+        effective, int(cfg.master_freq), testerfirst, testerlast,
+        str(cfg.valid_mode),
     )
     if rank in sranks:
         server = ParamServer(
@@ -188,10 +216,11 @@ def main(argv: Optional[List[str]] = None) -> None:
             f"have {BiCNNTrainer.KNOWN_OPTS}"
         )
     effective = min(int(cfg.np), int(cfg.maxrank) + 1)
+    tester_flags = resolve_tester_flags(cfg)  # validate even for np=1
     if effective > 1:
         assign_roles(
-            effective, int(cfg.master_freq), bool(cfg.testerfirst),
-            bool(cfg.testerlast), str(cfg.valid_mode),
+            effective, int(cfg.master_freq), *tester_flags,
+            str(cfg.valid_mode),
         )
     t0 = time.monotonic()
     if int(cfg.np) == 1:

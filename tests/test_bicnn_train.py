@@ -174,6 +174,25 @@ class TestAssignRoles:
         with pytest.raises(ValueError, match="exclusive"):
             assign_roles(6, 2, testerfirst=True, testerlast=True)
 
+    def test_unified_tester_surface(self):
+        """tester=none|first|last (the launch.py dialect) maps onto the
+        plaunch booleans; conflicts between the surfaces raise."""
+        from mpit_tpu.train.bicnn_launch import resolve_tester_flags
+
+        mk = lambda **kw: BICNN_LAUNCH_DEFAULTS.merged(**kw)
+        assert resolve_tester_flags(mk(tester="first")) == (True, False)
+        assert resolve_tester_flags(mk(tester="last")) == (False, True)
+        assert resolve_tester_flags(mk(tester="none")) == (False, False)
+        # Booleans still work alone, and agreeing surfaces are fine.
+        assert resolve_tester_flags(mk(testerlast=True)) == (False, True)
+        assert resolve_tester_flags(
+            mk(tester="last", testerlast=True)
+        ) == (False, True)
+        with pytest.raises(ValueError, match="conflicting"):
+            resolve_tester_flags(mk(tester="first", testerlast=True))
+        with pytest.raises(ValueError, match="tester must be"):
+            resolve_tester_flags(mk(tester="both"))
+
 
 class TestServerRule:
     def test_adam_gets_stepdiv(self):
@@ -209,6 +228,7 @@ def run_topology(size, cfg, data, timeout=600):
     return results
 
 
+@pytest.mark.slow
 class TestTopologies:
     def test_downpour_np4(self, data):
         cfg = BICNN_LAUNCH_DEFAULTS.merged(TINY).merged(

@@ -93,6 +93,7 @@ def test_decoder_ring_equals_local(rng, mesh):
     )
 
 
+@pytest.mark.slow
 def test_decoder_trains_with_ring(rng, mesh):
     """A few LM steps through ring attention reduce next-token loss."""
     tokens = jnp.asarray(rng.integers(0, 32, size=(4, 32)), jnp.int32)
@@ -154,14 +155,14 @@ class TestZigzag:
         g2 = jax.grad(lambda q: jnp.sum(_ref(q, k, v, True) ** 2))(q)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=5e-5)
 
-    @pytest.mark.parametrize("layout,causal", [
-        ("contiguous", False), ("contiguous", True), ("zigzag", True),
-    ])
-    def test_pallas_bwd_ring_matches_full(self, rng, mesh, layout, causal):
-        """The pallas backward ring ((dk, dv) riding the KV rotation,
-        per-pair flash-bwd kernels) against dense-oracle grads for all
-        three inputs."""
-        q, k, v = _qkv(rng)
+    def _check_pallas_bwd_ring(self, rng, layout, causal, n, L):
+        """Shared body: pallas backward ring ((dk, dv) riding the KV
+        rotation, per-pair flash-bwd kernels) against dense-oracle grads
+        for all three inputs."""
+        from mpit_tpu.utils.platform import default_devices
+
+        mesh = sp_mesh(default_devices()[:n])
+        q, k, v = _qkv(rng, (1, L, 1, 16))
         g = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
         ring = ring_attention(
             mesh, causal=causal, impl="pallas", layout=layout,
@@ -173,8 +174,25 @@ class TestZigzag:
         for a, b, nm in zip(vjp1(g), vjp2(g), "qkv"):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=5e-4,
-                err_msg=f"d{nm} layout={layout} causal={causal}",
+                err_msg=f"d{nm} layout={layout} causal={causal} n={n}",
             )
+
+    @pytest.mark.parametrize("layout,causal", [
+        ("contiguous", False), ("contiguous", True), ("zigzag", True),
+    ])
+    def test_pallas_bwd_ring_matches_full(self, rng, layout, causal):
+        # 2-device ring: every structural element (rotation, the final
+        # homing hop, all four zigzag liveness cases) exists at n=2, and
+        # interpret-mode pallas per-call cost stays test-suite friendly.
+        self._check_pallas_bwd_ring(rng, layout, causal, n=2, L=16)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("layout,causal", [
+        ("contiguous", False), ("contiguous", True), ("zigzag", True),
+    ])
+    def test_pallas_bwd_ring_matches_full_deep(self, rng, layout, causal):
+        # Multi-hop ring: owner arithmetic asymmetries only visible n>2.
+        self._check_pallas_bwd_ring(rng, layout, causal, n=4, L=32)
 
     def test_zigzag_requires_causal(self, mesh):
         with pytest.raises(ValueError, match="causal"):

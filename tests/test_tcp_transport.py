@@ -246,6 +246,7 @@ t.close()
         assert "RANK0 OK" in outs[0] and "RANK1 OK" in outs[1]
 
 
+@pytest.mark.slow
 class TestGangOverTcp:
     def test_mnist_gang_tcp(self):
         """np=2 launcher gang wired over TCP instead of shm."""

@@ -168,6 +168,7 @@ class TestDevicePolicy:
         with _pytest.raises(ValueError, match="device_policy"):
             device_env_overrides(cfg.merged(device_policy="gpu4"), 4)
 
+    @pytest.mark.slow
     def test_gang_applies_policy(self, monkeypatch):
         """np=2 gang with device_policy=cpu: children report the forced
         platform.  The parent's inherited JAX_PLATFORMS is removed so the
