@@ -19,16 +19,21 @@ caller buffers, cancellable — is provided by three backends:
 - :class:`mpit_tpu.comm.tcp.TcpTransport`: cross-host sockets with the
   identical contract — the DCN-side transport for the reference's
   multi-node hostfile deployments (reference BiCNN/hostfiles).
-- :mod:`mpit_tpu.comm.collectives`: the on-ICI path — shard exchange
-  expressed as XLA collectives (ppermute/psum/all_gather) under shard_map,
-  for the gang-scheduled synchronous modes where devices run in lockstep.
+
+On top of any of the three, :class:`mpit_tpu.comm.collectives.
+HostCollectives` provides the host-side collectives the reference's rank
+processes get from MPI — allreduce/bcast/reduce/barrier plus the
+Iallreduce analog (reference mpifuncs.c:83,:145,:1357) — for role-process
+coordination with no accelerator in the loop.  (Device collectives ride
+XLA over ICI instead: :mod:`mpit_tpu.parallel.collective`.)
 """
 
 from mpit_tpu.comm.transport import Handle, Transport
 from mpit_tpu.comm.local import LocalRouter, LocalTransport
 from mpit_tpu.comm.tcp import TcpTransport, allocate_local_addresses
+from mpit_tpu.comm.collectives import HostCollectives
 
 __all__ = [
     "Transport", "Handle", "LocalRouter", "LocalTransport",
-    "TcpTransport", "allocate_local_addresses",
+    "TcpTransport", "allocate_local_addresses", "HostCollectives",
 ]

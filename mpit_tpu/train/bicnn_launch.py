@@ -52,6 +52,7 @@ BICNN_LAUNCH_DEFAULTS = BICNN_DEFAULTS.merged(
     # plaunch.lua:10-12) remain as aliases; setting both surfaces
     # inconsistently is an error.
     tester="",
+    gang_barrier=True,  # startup rendezvous before any role traffic
 )
 
 

@@ -25,7 +25,13 @@ from mpit_tpu.utils.config import Config
 def child_transport(cfg: Config, rank: int, size: int):
     """The gang's wire: shm rings on one host (default), TCP across hosts
     (``transport=tcp`` + ``tcp_addrs=host:port,...`` — one address per
-    rank, the hostfile-deployment analog)."""
+    rank, the hostfile-deployment analog).
+
+    Every gang synchronizes on a startup barrier
+    (:class:`mpit_tpu.comm.collectives.HostCollectives`) before any role
+    traffic, so a slow-to-spawn rank can't race the PS seeding protocol
+    (the mpirun-gives-you-this guarantee; disable with gang_barrier=0).
+    """
     if cfg.get("transport", "shm") == "tcp":
         from mpit_tpu.comm.tcp import TcpTransport
 
@@ -35,12 +41,19 @@ def child_transport(cfg: Config, rank: int, size: int):
                 f"transport=tcp needs {size} comma-separated tcp_addrs, "
                 f"got {len(addrs)}"
             )
-        return TcpTransport(rank, size, addrs)
-    from mpit_tpu.comm.shm import ShmTransport
+        transport = TcpTransport(rank, size, addrs)
+    else:
+        from mpit_tpu.comm.shm import ShmTransport
 
-    return ShmTransport(
-        cfg.namespace, rank, size, ring_bytes=int(cfg.get("ring_mb", 64)) << 20
-    )
+        transport = ShmTransport(
+            cfg.namespace, rank, size,
+            ring_bytes=int(cfg.get("ring_mb", 64)) << 20,
+        )
+    if bool(cfg.get("gang_barrier", True)):
+        from mpit_tpu.comm.collectives import HostCollectives
+
+        HostCollectives(transport).barrier()
+    return transport
 
 
 def launch_gang(

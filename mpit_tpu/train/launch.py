@@ -51,6 +51,7 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     # host:port per rank, comma-separated — the hostfile analog).
     transport="shm",
     tcp_addrs="",
+    gang_barrier=True,  # startup rendezvous before any role traffic
 )
 
 

@@ -64,3 +64,15 @@ def test_testreduceall():
     assert r["metric"] == "allreduce_ms_per_round"
     assert r["value"] > 0 and r["devices"] == 4
     assert r["async_ms_per_round"] > 0
+
+
+def test_testreduceall_shm_mode():
+    """Host-transport leg: ring allreduce between real processes over the
+    shm transport (the literal test/testreduceall.lua shape)."""
+    (r,) = run_bench(
+        "testreduceall.py",
+        {"MEGS": "1", "MPIT_BENCH_MODE": "shm", "MPIT_BENCH_RANKS": "3"},
+    )
+    assert r["metric"] == "host_allreduce_bandwidth_shm"
+    assert r["value"] > 0 and r["ranks"] == 3
+    assert r["ms_per_round"] > 0

@@ -1,0 +1,160 @@
+"""Host collectives over the Transport contract — the reference's MPI
+Allreduce/Bcast/Iallreduce surface (mpifuncs.c:83,:145,:1357;
+test/testreduceall.lua) rebuilt over the framework's own transports.
+
+Each rank runs on its own thread over in-process endpoints (np=5 covers
+non-power-of-two tree/ring shapes); one leg repeats allreduce over real
+TCP sockets for cross-transport parity.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mpit_tpu.comm import HostCollectives
+from mpit_tpu.comm.local import LocalRouter
+
+N = 5  # odd, >4: exercises uneven ring chunks and ragged binomial trees
+
+
+def run_ranks(n, fn):
+    """fn(collectives, rank) on one thread per rank; returns results."""
+    router = LocalRouter(n)
+    out = [None] * n
+    errs = [None] * n
+
+    def body(r):
+        try:
+            out[r] = fn(HostCollectives(router.endpoint(r)), r)
+        except BaseException as e:  # surfaced below
+            errs[r] = e
+
+    threads = [threading.Thread(target=body, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "collective hung"
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
+class TestHostCollectives:
+    @pytest.mark.parametrize("size", [7, 4096])  # small: tree; large: ring
+    def test_allreduce_sum(self, rng, size):
+        inputs = [rng.normal(size=size).astype(np.float32) for _ in range(N)]
+        want = np.sum(np.stack(inputs), axis=0)
+
+        def body(coll, r):
+            arr = inputs[r].copy()
+            coll.allreduce(arr)
+            return arr
+
+        for arr in run_ranks(N, body):
+            np.testing.assert_allclose(arr, want, rtol=1e-4, atol=1e-5)
+
+    def test_allreduce_max(self, rng):
+        inputs = [rng.normal(size=300).astype(np.float32) for _ in range(N)]
+        want = np.max(np.stack(inputs), axis=0)
+        out = run_ranks(N, lambda c, r: c.allreduce(inputs[r].copy(), op="max"))
+        for arr in out:
+            np.testing.assert_array_equal(arr, want)
+
+    @pytest.mark.parametrize("root", [0, 3])
+    def test_bcast(self, rng, root):
+        seed = rng.normal(size=513).astype(np.float32)
+
+        def body(coll, r):
+            arr = seed.copy() if r == root else np.zeros(513, np.float32)
+            return coll.bcast(arr, root=root)
+
+        for arr in run_ranks(N, body):
+            np.testing.assert_array_equal(arr, seed)
+
+    def test_reduce_to_root(self, rng):
+        inputs = [rng.normal(size=64).astype(np.float32) for _ in range(N)]
+        want = np.sum(np.stack(inputs), axis=0)
+        out = run_ranks(N, lambda c, r: (c.reduce(inputs[r].copy()), r)[0])
+        np.testing.assert_allclose(out[0], want, rtol=1e-4, atol=1e-5)
+
+    def test_barrier_synchronizes(self):
+        """Every rank's pre-barrier write is visible to every rank after
+        the barrier, across repeated rounds."""
+        arrived = [np.zeros(N, bool) for _ in range(3)]
+
+        def body(coll, r):
+            for k in range(3):
+                arrived[k][r] = True
+                coll.barrier()
+                assert arrived[k].all(), f"round {k}: barrier exited early"
+            return True
+
+        run_ranks(N, body)
+
+    def test_iallreduce_test_wait(self, rng):
+        """Iallreduce analog: test() may poll False mid-flight, wait()
+        completes, results match (testireduceall.lua:32-39 shape)."""
+        inputs = [rng.normal(size=2048).astype(np.float32) for _ in range(N)]
+        want = np.sum(np.stack(inputs), axis=0)
+
+        def body(coll, r):
+            arr = inputs[r].copy()
+            h = coll.allreduce_async(arr)
+            h.test()  # legal mid-flight
+            h.wait(60)
+            assert h.test() is True
+            return arr
+
+        for arr in run_ranks(N, body):
+            np.testing.assert_allclose(arr, want, rtol=1e-4, atol=1e-5)
+
+    def test_back_to_back_no_crosstalk(self, rng):
+        """Consecutive collectives use fresh tag rounds: a sum right
+        after a max must not mix messages."""
+
+        def body(coll, r):
+            a = np.full(100, float(r), np.float32)
+            b = np.full(100, float(r), np.float32)
+            coll.allreduce(a, op="max")
+            coll.allreduce(b, op="sum")
+            return a[0], b[0]
+
+        for mx, sm in run_ranks(N, body):
+            assert mx == N - 1 and sm == sum(range(N))
+
+    def test_rejects_noncontiguous(self):
+        router = LocalRouter(1)
+        coll = HostCollectives(router.endpoint(0))
+        with pytest.raises(ValueError, match="contiguous"):
+            coll.allreduce(np.zeros((4, 4), np.float32)[:, ::2])
+
+    def test_allreduce_over_tcp(self, rng):
+        """Cross-transport parity: the same ring over real sockets."""
+        from tests.test_tcp_transport import make_mesh_transports
+
+        n = 4
+        transports = make_mesh_transports(n)
+        inputs = [rng.normal(size=1024).astype(np.float32) for _ in range(n)]
+        want = np.sum(np.stack(inputs), axis=0)
+        out = [None] * n
+
+        def body(r):
+            arr = inputs[r].copy()
+            HostCollectives(transports[r]).allreduce(arr)
+            out[r] = arr
+
+        threads = [threading.Thread(target=body, args=(r,)) for r in range(n)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+                assert not t.is_alive()
+        finally:
+            for tr in transports:
+                tr.close()
+        for arr in out:
+            np.testing.assert_allclose(arr, want, rtol=1e-4, atol=1e-5)
