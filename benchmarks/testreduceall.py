@@ -74,13 +74,13 @@ def _shm_child() -> None:
     h.wait(600)
     assert h.test() is True
 
+    # Correctness: check one clean allreduce of the seeded uniforms on a
+    # fresh buffer (after k timed rounds the main buffer holds
+    # size^k-weighted mixes).  Every rank participates; rank 0 asserts.
+    fresh = base.copy()
+    coll2 = HostCollectives(t, tag_base=1 << 24)
+    coll2.allreduce(fresh)
     if rank == 0:
-        # Correctness: ROUNDS sums of per-rank seeded uniforms.  After k
-        # allreduces the buffer holds size^k-weighted mixes; check round 1
-        # algebra on a fresh buffer instead for a clean invariant.
-        fresh = base.copy()
-        coll2 = HostCollectives(t, tag_base=1 << 24)
-        coll2.allreduce(fresh)
         expect = np.zeros_like(base)
         for r in range(size_ranks):
             expect += np.random.default_rng(r).uniform(
@@ -96,10 +96,6 @@ def _shm_child() -> None:
             "payload_mb": round(n_elems * 4 / 2**20, 1),
             "ranks": size_ranks,
         }))
-    else:
-        fresh = base.copy()
-        coll2 = HostCollectives(t, tag_base=1 << 24)
-        coll2.allreduce(fresh)
     coll.barrier()
     t.close()
 
@@ -123,13 +119,15 @@ def _shm_parent(nranks: int, timeout: float = 300.0) -> None:
         ))
     deadline = time.monotonic() + timeout
     failed = None
-    while time.monotonic() < deadline:
+    while True:
         codes = [p.poll() for p in procs]
         if any(c not in (None, 0) for c in codes):
             failed = codes
             break
         if all(c == 0 for c in codes):
             return
+        if time.monotonic() >= deadline:
+            break
         time.sleep(0.2)
     for p in procs:  # straggler or failure: kill the gang
         if p.poll() is None:

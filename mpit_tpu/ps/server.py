@@ -44,6 +44,8 @@ class ParamServer:
         scheduler: Optional[Scheduler] = None,
         dtype=np.float32,
         single_mode: bool = False,
+        ckpt_dir: Optional[str] = None,
+        ckpt_interval: float = 30.0,
     ):
         self.rank = rank
         self.cranks = list(client_ranks)
@@ -68,6 +70,10 @@ class ParamServer:
         self.grads_applied = 0
         self.params_served = 0
         self._restored = False
+        # Periodic shard checkpointing (the resume flow's producer side).
+        self._ckpt_dir = str(ckpt_dir) if ckpt_dir else None
+        self._ckpt_interval = float(ckpt_interval)
+        self.ckpts_written = 0
 
     # -- service generators (reference pserver.lua coroutines) --------------
 
@@ -201,6 +207,27 @@ class ParamServer:
         self._param_staging = np.zeros((size,), dtype=self.dtype)
         self._restored = True
 
+    def _serve_with_checkpoints(self) -> None:
+        """Drive the service queue like ``Scheduler.wait`` while writing
+        the shard checkpoint every ``ckpt_interval`` seconds and once
+        more at stop.  Safe point: a ping runs one generator step, and a
+        grad apply commits within one step — between pings the shard is
+        never torn."""
+        import time as _time
+
+        next_save = _time.monotonic() + self._ckpt_interval
+        while self.sched.queue:
+            self.sched.ping()
+            if _time.monotonic() >= next_save:
+                self.save_state(self._ckpt_dir)
+                self.ckpts_written += 1
+                next_save = _time.monotonic() + self._ckpt_interval
+        if self.param is not None:
+            self.save_state(self._ckpt_dir)  # final state at stop
+            self.ckpts_written += 1
+        if self.sched.errors:
+            raise self.sched.errors.pop(0)
+
     # -- orchestration (reference pserver.lua:131-157) ----------------------
 
     def start(self) -> None:
@@ -234,7 +261,10 @@ class ParamServer:
                 self.sched.spawn(
                     self._recv_param(crank, once=False), name=f"recv_param:{crank}"
                 )
-        self.sched.wait()
+        if self._ckpt_dir:
+            self._serve_with_checkpoints()
+        else:
+            self.sched.wait()
         self.log.debug(
             "stopped: %d grads applied, %d params served",
             self.grads_applied,

@@ -52,6 +52,14 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     transport="shm",
     tcp_addrs="",
     gang_barrier=True,  # startup rendezvous before any role traffic
+    # Server shard checkpointing + resume (beyond-reference — SURVEY §5:
+    # the reference never checkpoints server state).  server_ckpt_dir
+    # activates periodic per-server shard+rule-state snapshots; --resume
+    # restores them and skips client seeding so Adam/RMSProp moments
+    # survive a restart.
+    server_ckpt_dir="",
+    server_ckpt_interval=30.0,
+    resume=False,
 )
 
 
@@ -96,6 +104,13 @@ def run_rank(
     """Run one rank's role to completion; returns its result dict."""
     log = get_logger("launch", rank)
     if size == 1:
+        if bool(cfg.get("resume", False)):
+            # Server-shard resume needs servers; silently restarting from
+            # scratch would look like a successful resume.
+            raise ValueError(
+                "--resume restores parameter-server shards and needs "
+                "--np > 1 (single-process runs have no servers)"
+            )
         trainer = MnistTrainer(cfg, pclient=None, data=data, rank=rank)
         return {"role": "local", **trainer.run()}
 
@@ -111,19 +126,37 @@ def run_rank(
         # The tester counts as a (pull-only) client: it announces shards and
         # participates in the stop protocol like any worker.
         all_clients = cranks + ([tester_rank] if tester_rank is not None else [])
+        ckpt_dir = str(cfg.get("server_ckpt_dir", "") or "")
         server = ParamServer(
             rank, all_clients, transport, rule=server_rule_for(cfg),
             single_mode=single_mode, dtype=cfg.get("dtype", "float32"),
+            ckpt_dir=ckpt_dir or None,
+            ckpt_interval=float(cfg.get("server_ckpt_interval", 30.0)),
         )
+        if bool(cfg.get("resume", False)):
+            import pathlib
+
+            path = pathlib.Path(ckpt_dir) / f"server{rank}_latest.npz"
+            if not ckpt_dir or not path.exists():
+                raise FileNotFoundError(
+                    f"--resume needs --server_ckpt_dir with a "
+                    f"server{rank}_latest.npz (looked at {path})"
+                )
+            server.restore_state(path)
+            log.info("restored shard from %s", path)
         log.info("server for clients %s", cranks)
         server.start()
         return {
             "role": "server",
             "grads_applied": server.grads_applied,
             "params_served": server.params_served,
+            "ckpts_written": server.ckpts_written,
         }
+    # On resume the restored servers are authoritative for params — no
+    # client re-seeds (ps/server.py restore_state contract).
     pclient = ParamClient(
-        rank, sranks, transport, seed_servers=(rank == cranks[0])
+        rank, sranks, transport,
+        seed_servers=(rank == cranks[0]) and not bool(cfg.get("resume", False)),
     )
     trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
     log.info("worker with servers %s", sranks)

@@ -8,6 +8,7 @@ Topology helpers run each server's blocking event loop on its own thread
 
 import contextlib
 import threading
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -245,6 +246,41 @@ class TestPSWithOptimizers:
 
 
 class TestServerCheckpointResume:
+    def test_periodic_hook_writes_during_serve(self, rng, tmp_path):
+        """ckpt_dir + tiny interval: snapshots land while serving, plus a
+        final one at stop; the file restores cleanly."""
+        from mpit_tpu.utils.checkpoint import load_server_state
+
+        w0 = rng.normal(size=8).astype(np.float32)
+        n = 2
+        router = LocalRouter(n)
+        server = ParamServer(
+            0, [1], router.endpoint(0), rule="add",
+            ckpt_dir=tmp_path, ckpt_interval=0.02,
+        )
+        thread = threading.Thread(target=server.start, daemon=True)
+        thread.start()
+        try:
+            client = ParamClient(1, [0], router.endpoint(1), seed_servers=True)
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+            for i in range(4):
+                grad[:] = i + 1.0
+                client.async_send_grad()
+                client.wait()
+                time.sleep(0.03)  # let the interval elapse between applies
+            client.stop()
+            join_all([thread])
+        finally:
+            server.live.stop()
+        assert server.ckpts_written >= 2  # periodic + final
+        offset, size, param_ck, _state, meta = load_server_state(
+            tmp_path / "server0_latest.npz"
+        )
+        assert (offset, size) == (0, 8)
+        assert meta["grads_applied"] == 4
+        np.testing.assert_allclose(param_ck, w0 + 1 + 2 + 3 + 4, rtol=1e-6)
+
     def test_adam_resume_matches_uninterrupted(self, rng, tmp_path):
         """Save server shard state mid-training, restart the topology from
         the checkpoint, finish — result must match a never-interrupted

@@ -185,3 +185,33 @@ class TestDevicePolicy:
         results = launch_processes(cfg, timeout=600)
         assert set(results) == {0, 1}
         assert all(r.get("platform") == "cpu" for r in results.values())
+
+
+@pytest.mark.slow
+class TestServerCkptResumeGang:
+    def test_two_session_resume(self, tmp_path):
+        """Session 1 trains with periodic server checkpoints; session 2
+        resumes from them (servers restore, no client seeding) and keeps
+        training — the launcher-level resume flow the in-process PS tests
+        cover at the API level."""
+        from mpit_tpu.train.launch import LAUNCH_DEFAULTS, launch_processes
+
+        base = LAUNCH_DEFAULTS.merged(
+            np=3, opt="downpour", epochs=1, model="linear", side=8,
+            batch=64, master_freq=2, device_policy="cpu",
+            server_ckpt_dir=str(tmp_path), server_ckpt_interval=0.2,
+        )
+        r1 = launch_processes(base, timeout=600)
+        servers1 = {r: v for r, v in r1.items() if v["role"] == "server"}
+        assert servers1 and all(v["ckpts_written"] >= 1 for v in servers1.values())
+        for r in servers1:
+            assert (tmp_path / f"server{r}_latest.npz").exists()
+
+        r2 = launch_processes(base.merged(resume=True), timeout=600)
+        servers2 = {r: v for r, v in r2.items() if v["role"] == "server"}
+        workers2 = [v for v in r2.values() if v["role"] == "worker"]
+        # Restored moment/param state: grads_applied continues the count
+        # from session 1 instead of restarting at the session's own total.
+        for r, v in servers2.items():
+            assert v["grads_applied"] > servers1[r]["grads_applied"]
+        assert workers2 and all("final_test_err" in w for w in workers2)
