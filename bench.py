@@ -1,141 +1,158 @@
-"""Headline benchmark: flagship MNIST EASGD training throughput.
+"""Headline benchmark: the BASELINE north-star, measured on real training.
 
-Measures samples/sec of the jitted elastic-averaging train step (the
-mlaunch.lua flagship path, reference asyncsgd/mlaunch.lua:39-47 /
-optim-eamsgd.lua) on the available accelerator, with parameters and the
-elastic center sharded over the device mesh.
+Three metrics in one JSON line (reference shapes: asyncsgd/goot.lua:144-157
+time-to-test-error loop, asyncsgd/ptest.lua:58-67 push/pull MB/s):
 
-``vs_baseline`` compares against a live-measured reference-equivalent:
-the same CNN + Nesterov-SGD step in torch on host CPU — the reference
-ran its ranks on CPU torch (SURVEY.md §6; the repo publishes no numbers,
-BASELINE.md), so CPU-torch throughput of the identical workload is the
-honest stand-in.  >1.0 means this framework beats the reference-shaped
-run.
+- ``value`` / ``metric`` — steady-state training throughput (samples/s)
+  of the flagship MNIST EASGD mesh trainer (mlaunch.lua:39-47 path).
+  Each epoch is a fresh shuffle staged to HBM in one transfer (the
+  framework's device_stream input pipeline); every step trains a
+  different batch; timing is the latency-cancelled fetch-fenced recipe
+  of :mod:`mpit_tpu.utils.timing` over whole epoch passes.
+- ``time_to_target_s`` — wall-clock from process t0 to the first epoch
+  whose test error <= ``target_test_err`` (includes compile, as a user
+  would experience it).  ``data_source`` names what was trained on — this
+  environment has no real MNIST; the loader falls back to sklearn-digits
+  (data/mnist.py docstring).
+- ``ps_pushpull_mbs_per_chip`` — bi-directional PS shard push/pull
+  bandwidth per chip over the mesh ``shard`` axis (the ptest.lua
+  measurement riding ICI collectives instead of MPI).
 
-Prints exactly one JSON line to stdout.
+``vs_baseline`` compares throughput against a live-measured
+reference-equivalent: the same CNN + Nesterov-SGD step in torch on host
+CPU with the same staged-epoch input pipeline (one permuted tensor per
+epoch, per-step slices) — the reference ran its ranks on CPU torch
+(SURVEY.md §6) and publishes no absolute numbers (BASELINE.md), so
+CPU-torch throughput of the identical workload is the honest stand-in.
+>1.0 means this framework beats the reference-shaped run.
+
+Env knobs: MPIT_BENCH_EPOCHS (default 30), MPIT_BENCH_MB (PS payload,
+default 64), MPIT_BENCH_ROUNDS (default 20).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+# stdout carries exactly one JSON line (the driver contract); all
+# framework logging goes to stderr.
+os.environ.setdefault("MPIT_LOG_STREAM", "stderr")
+
 BATCH = 128
 SIDE = 32
-WIDTH = 32
-WARMUP = 20
-ITERS = 500
-TORCH_ITERS = 10
+EPOCHS = int(os.environ.get("MPIT_BENCH_EPOCHS", "30"))
+PS_MB = float(os.environ.get("MPIT_BENCH_MB", "64"))
+PS_ROUNDS = int(os.environ.get("MPIT_BENCH_ROUNDS", "20"))
+TORCH_ITERS = 30
 
 
 def _log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def bench_jax() -> float:
-    import jax
-    import jax.numpy as jnp
+def bench_train() -> dict:
+    """Flagship mesh-EASGD run to target test error on the real stream."""
+    from mpit_tpu.train.mesh_launch import MESH_LAUNCH_DEFAULTS, run
 
-    from mpit_tpu.data.mnist import load_mnist
-    from mpit_tpu.models import MnistCNN, flatten_module
-    from mpit_tpu.optim.msgd import MSGDConfig
-    from mpit_tpu.parallel import MeshEASGD, make_mesh
-
-    from mpit_tpu.utils.platform import default_devices
-
-    devs = default_devices()
-    _log(f"jax devices: {devs}")
-    mesh = make_mesh(devs)
-    n_dp = mesh.shape["dp"]
-
-    (x_train, y_train, _, _), source = load_mnist(side=SIDE)
-    _log(f"data source: {source}")
-
-    module = MnistCNN(side=SIDE, num_classes=10, width=WIDTH)
-    x0 = jnp.asarray(x_train[:2], jnp.float32)
-    flat = flatten_module(module, jax.random.PRNGKey(0), x0)
-    _log(f"flat params: {flat.size}")
-
-    def vgf(w, xb, yb):
-        def loss_fn(w):
-            logp = flat.apply_flat(w, xb)
-            return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
-
-        return jax.value_and_grad(loss_fn)(w)
-
-    # mlaunch flagship config shape: mom=0.99, mva=beta/p, su=100ish; su=1
-    # here so the *measured* step includes the elastic exchange every step
-    # (worst case for us, most honest vs the async reference).
-    trainer = MeshEASGD(
-        mesh, vgf, MSGDConfig(lr=1e-2, mom=0.99), mva=0.9 / max(n_dp, 1), su=1
+    # target_test_err: BASELINE's north star is 1% on real MNIST; this
+    # environment has only the sklearn-digits fallback, where the flagship
+    # config plateaus at ~2.2% (it memorizes the 1527-example train split)
+    # — 2% is the achievable stand-in, and the JSON names both the target
+    # and the source.
+    target = float(os.environ.get("MPIT_BENCH_TARGET", "0.02"))
+    cfg = MESH_LAUNCH_DEFAULTS.merged(
+        opt="easgd", model="cnn", epochs=EPOCHS, batch=BATCH, side=SIDE,
+        su=10, mom=0.99, lr=1e-2, target_test_err=target, stop_at_target=1,
+        device_stream=1, measure_throughput=1,
     )
-    state = trainer.init(flat.w0)
+    result = run(cfg)
+    result["target_test_err"] = target
+    err = result["final_test_err"]
+    _log(
+        f"train: {result['samples_trained']} samples in "
+        f"{result['train_time']:.2f}s wall train-time "
+        f"({result['samples_per_sec']} samples/s wall, "
+        f"{result['samples_per_sec_steady']} steady); final test_err "
+        f"{'n/a' if err is None else format(err, '.4f')}; time_to_target "
+        f"{result['time_to_target']}; source {result['data_source']}"
+    )
+    return result
 
-    n = len(x_train)
-    per_worker = BATCH
-    need = n_dp * per_worker
-    idx = np.arange(need) % n
-    xs = jnp.asarray(x_train[idx].reshape(n_dp, per_worker, -1), jnp.float32)
-    ys = jnp.asarray(y_train[idx].reshape(n_dp, per_worker), jnp.int32)
-    batches = trainer.shard_batch(xs, ys)
 
-    for _ in range(WARMUP):
-        state, loss = trainer.step(state, *batches)
-    import jax as _j
+def bench_ps_pushpull() -> dict:
+    """ptest.lua analog: PS shard push/pull bandwidth over ICI (shared
+    implementation: :func:`mpit_tpu.parallel.collective.measure_ps_pushpull`)."""
+    from mpit_tpu.parallel.collective import measure_ps_pushpull
 
-    _j.block_until_ready(state["w"])
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        state, loss = trainer.step(state, *batches)
-    _j.block_until_ready(state["w"])
-    dt = time.perf_counter() - t0
-    sps = ITERS * n_dp * per_worker / dt
-    _log(f"jax: {ITERS} steps x {n_dp} workers x {per_worker} in {dt:.3f}s "
-         f"-> {sps:.1f} samples/s (loss {float(loss.mean()):.4f})")
-    return sps
+    r = measure_ps_pushpull(PS_MB, rounds=PS_ROUNDS)
+    _log(f"ps: {r['ms_per_round']:.2f} ms/round of {r['payload_mb']:.1f} MB "
+         f"-> {r['mbs']:.1f} MB/s ({r['per_chip']:.1f} MB/s/chip, "
+         f"{r['devices']} chips)")
+    return r
 
 
 def bench_torch_cpu() -> float:
-    """Reference-equivalent: identical CNN + Nesterov SGD, torch on CPU."""
+    """Reference-equivalent: identical CNN + Nesterov SGD, torch on CPU,
+    same staged-epoch pipeline as the jax leg (one permuted tensor per
+    epoch, per-step slices of fresh data)."""
     import torch
     import torch.nn as tnn
 
+    from mpit_tpu.data.mnist import load_mnist
+
+    (x_train, y_train, _, _), _src = load_mnist(side=SIDE)
     torch.manual_seed(0)
     torch.set_num_threads(max(torch.get_num_threads(), 1))
+    width = 32
     model = tnn.Sequential(
-        tnn.Conv2d(1, WIDTH, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
-        tnn.Conv2d(WIDTH, 2 * WIDTH, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
+        tnn.Conv2d(1, width, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
+        tnn.Conv2d(width, 2 * width, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
         tnn.Flatten(),
-        tnn.Linear((SIDE // 4) ** 2 * 2 * WIDTH, 4 * WIDTH), tnn.ReLU(),
-        tnn.Linear(4 * WIDTH, 10), tnn.LogSoftmax(dim=1),
+        tnn.Linear((SIDE // 4) ** 2 * 2 * width, 4 * width), tnn.ReLU(),
+        tnn.Linear(4 * width, 10), tnn.LogSoftmax(dim=1),
     )
     opt = torch.optim.SGD(model.parameters(), lr=1e-2, momentum=0.99, nesterov=True)
     lossf = tnn.NLLLoss()
-    x = torch.randn(BATCH, 1, SIDE, SIDE)
-    y = torch.randint(0, 10, (BATCH,))
+    n = len(x_train)
+    rng = np.random.default_rng(0)
+    steps = max(n // BATCH, 1)
+    order = rng.permutation(n)[: steps * BATCH]
+    x_ep = torch.from_numpy(
+        x_train[order].reshape(steps, BATCH, 1, SIDE, SIDE))
+    y_ep = torch.from_numpy(
+        y_train[order].astype(np.int64).reshape(steps, BATCH))
 
-    def step():
+    def step(i):
         opt.zero_grad()
-        loss = lossf(model(x), y)
+        loss = lossf(model(x_ep[i % steps]), y_ep[i % steps])
         loss.backward()
         opt.step()
 
-    for _ in range(3):
-        step()
+    for i in range(3):
+        step(i)
     t0 = time.perf_counter()
-    for _ in range(TORCH_ITERS):
-        step()
+    for i in range(TORCH_ITERS):
+        step(i)
     dt = time.perf_counter() - t0
     sps = TORCH_ITERS * BATCH / dt
-    _log(f"torch-cpu: {TORCH_ITERS} steps of {BATCH} in {dt:.3f}s -> {sps:.1f} samples/s")
+    _log(f"torch-cpu: {TORCH_ITERS} staged steps of {BATCH} in {dt:.3f}s "
+         f"-> {sps:.1f} samples/s")
     return sps
 
 
 def main():
-    sps = bench_jax()
+    train = bench_train()
+    sps = train["samples_per_sec_steady"] or train["samples_per_sec"] or 0.0
+    try:
+        ps = bench_ps_pushpull()
+    except Exception as e:
+        _log(f"ps bandwidth leg failed: {e!r}")
+        ps = {"per_chip": None, "devices": 0}
     try:
         base = bench_torch_cpu()
         vs = sps / base if base > 0 else 0.0
@@ -147,6 +164,15 @@ def main():
         "value": round(sps, 1),
         "unit": "samples/s",
         "vs_baseline": round(vs, 3),
+        "time_to_target_s": round(train["time_to_target"], 3)
+        if train["time_to_target"] is not None else None,
+        "target_test_err": train["target_test_err"],
+        "final_test_err": train["final_test_err"],
+        "epochs_run": len(train["history"]),
+        "data_source": train["data_source"],
+        "ps_pushpull_mbs_per_chip": round(ps["per_chip"], 1)
+        if ps["per_chip"] else None,
+        "ps_devices": ps["devices"],
     }))
 
 

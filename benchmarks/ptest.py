@@ -46,43 +46,18 @@ NCLIENTS = int(os.environ.get("MPIT_BENCH_CLIENTS", "2"))
 
 
 def bench_ici() -> dict:
-    import jax
-    import jax.numpy as jnp
+    from mpit_tpu.parallel.collective import measure_ps_pushpull
 
-    from mpit_tpu.parallel import make_mesh
-    from mpit_tpu.parallel.collective import ps_pushpull
-    from mpit_tpu.parallel.mesh import param_sharding
-
-    from mpit_tpu.utils.platform import default_devices
-
-    devs = default_devices()
-    mesh = make_mesh(devs, dp=1)  # all devices on the shard axis
-    n = mesh.shape["shard"]
-    size = int(MB * (1 << 20) / 4 // n * n)
-    _log(f"[ici] {len(devs)} devices, payload {size * 4 / 2**20:.1f} MB")
-
-    roundtrip = jax.jit(ps_pushpull(mesh, lambda p, g: p + g))
-    p_shard = jax.device_put(
-        jnp.zeros((size,), jnp.float32), param_sharding(mesh)
-    )
-    grad = jnp.ones((size,), jnp.float32)
-
-    full, p_shard = roundtrip(p_shard, grad)  # compile + warm
-    jax.block_until_ready(full)
-    t0 = time.perf_counter()
-    for _ in range(ROUNDS):
-        full, p_shard = roundtrip(p_shard, grad)
-    jax.block_until_ready(full)
-    dt = time.perf_counter() - t0
-    mbs = 2 * ROUNDS * size * 4 / dt / 2**20  # reference formula
-    _log(f"[ici] {ROUNDS} rounds in {dt:.3f}s -> {mbs:.1f} MB/s "
-         f"({mbs / n:.1f} MB/s/chip)")
+    r = measure_ps_pushpull(MB, rounds=ROUNDS)
+    _log(f"[ici] {r['devices']} devices, payload {r['payload_mb']:.1f} MB: "
+         f"{r['ms_per_round']:.2f} ms/round -> {r['mbs']:.1f} MB/s "
+         f"({r['per_chip']:.1f} MB/s/chip)")
     return {
         "metric": "ps_pushpull_bandwidth_ici",
-        "value": round(mbs, 1),
+        "value": round(r["mbs"], 1),
         "unit": "MB/s",
-        "per_chip": round(mbs / n, 1),
-        "devices": n,
+        "per_chip": round(r["per_chip"], 1),
+        "devices": r["devices"],
     }
 
 

@@ -6,11 +6,11 @@ test/testreduceall.lua:8-9,31-33) and a nonblocking Iallreduce with
 Test-before/after-Wait (test/testireduceall.lua:32-39), plus a seeded
 correctness print (asyncsgd/testreduceall.lua:72-77).  TPU-native:
 
-- blocking analog — jitted ``psum`` over every device (shard_map), timed
-  with ``block_until_ready`` per round;
-- nonblocking analog — the same op dispatched ROUNDS times *ahead*
-  before a single block (XLA's async dispatch is the Iallreduce: the
-  host thread runs free while collectives execute);
+- device analog — jitted ``psum`` over every device (shard_map), timed
+  with the latency-cancelled fetch-fenced recipe of
+  :mod:`mpit_tpu.utils.timing` (XLA's async dispatch already gives the
+  Iallreduce overlap the reference tests separately: the host thread
+  runs free while collectives execute);
 - correctness — the psum of seeded per-device uniforms must equal the
   numpy sum of the same stacked array.
 
@@ -177,29 +177,17 @@ def main():
     np.testing.assert_allclose(out[:size], expect, rtol=1e-4)
     _log("correctness: psum == stacked numpy sum")
 
-    # Blocking rounds.
-    jax.block_until_ready(allreduce(x))
-    t0 = time.perf_counter()
-    for _ in range(ROUNDS):
-        jax.block_until_ready(allreduce(x))
-    dt_block = time.perf_counter() - t0
+    # Latency-cancelled, fetch-fenced timing (mpit_tpu.utils.timing) —
+    # block_until_ready returns early on tunneled platforms.
+    from mpit_tpu.utils.timing import timed_per_call
 
-    # Nonblocking: dispatch every round ahead, block once at the end.
-    t0 = time.perf_counter()
-    ys = [allreduce(x) for _ in range(ROUNDS)]
-    dt_dispatch = time.perf_counter() - t0
-    jax.block_until_ready(ys)
-    dt_async = time.perf_counter() - t0
-
-    per_round_ms = dt_block / ROUNDS * 1e3
-    _log(f"blocking: {per_round_ms:.2f} ms/round; async total "
-         f"{dt_async / ROUNDS * 1e3:.2f} ms/round "
-         f"(dispatch {dt_dispatch * 1e3:.1f} ms for {ROUNDS})")
+    per_round = timed_per_call(allreduce, x, iters=ROUNDS)
+    per_round_ms = per_round * 1e3
+    _log(f"{per_round_ms:.2f} ms/round")
     print(json.dumps({
         "metric": "allreduce_ms_per_round",
         "value": round(per_round_ms, 3),
         "unit": "ms",
-        "async_ms_per_round": round(dt_async / ROUNDS * 1e3, 3),
         "payload_mb": round(size * 4 / 2**20, 1),
         "devices": n,
     }))

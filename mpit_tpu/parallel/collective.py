@@ -123,3 +123,32 @@ def allreduce_mean(mesh: Mesh, axis: str = "dp"):
     return shard_map(
         _mean, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False
     )
+
+
+def measure_ps_pushpull(mb: float, rounds: int = 20) -> dict:
+    """Measured PS push/pull bandwidth over the mesh ``shard`` axis — the
+    one shared implementation of the asyncsgd/ptest.lua:58-67 measurement
+    (``2*T*ssize*4/elapsed`` MB/s), used by both ``benchmarks/ptest.py``
+    and the repo-root ``bench.py`` so the formula and payload sizing
+    cannot drift apart.  Timing is the latency-cancelled fetch-fenced
+    recipe of :mod:`mpit_tpu.utils.timing`."""
+    from mpit_tpu.parallel.mesh import make_mesh, param_sharding
+    from mpit_tpu.utils.platform import default_devices
+    from mpit_tpu.utils.timing import timed_per_call
+
+    devs = default_devices()
+    mesh = make_mesh(devs, dp=1)  # all devices on the shard axis
+    n = mesh.shape["shard"]
+    size = int(mb * (1 << 20) / 4 // n * n)
+
+    roundtrip = jax.jit(ps_pushpull(mesh, lambda p, g: p + g))
+    p_shard = jax.device_put(
+        jnp.zeros((size,), jnp.float32), param_sharding(mesh)
+    )
+    grad = jnp.ones((size,), jnp.float32)
+    per_round = timed_per_call(roundtrip, p_shard, grad, iters=rounds)
+    mbs = 2 * size * 4 / per_round / 2**20  # reference formula, per round
+    return {
+        "mbs": mbs, "per_chip": mbs / n, "devices": n,
+        "payload_mb": size * 4 / 2**20, "ms_per_round": per_round * 1e3,
+    }

@@ -161,10 +161,59 @@ def server_rule_for(cfg: Config):
 
 def gesd_np(q: np.ndarray, a: np.ndarray) -> np.ndarray:
     """Host-side GESD over (F,) x (P, F) — the eval-time inlined formula
-    (reference bicnn.lua:440-443)."""
+    (reference bicnn.lua:440-443).  Kept as the semantic oracle for the
+    device scorer (:func:`_pool_score`); tests compare the two."""
     dot = a @ q
     l2 = np.sqrt(np.maximum(((a - q) ** 2).sum(axis=-1), 0.0))
     return 1.0 / ((1.0 + l2) * (1.0 + np.exp(-(dot + 1.0))))
+
+
+def _pool_score(q_emb, ans_emb, idx, mask, hit):
+    """Device-side pool-restricted selection: correct count over all
+    questions in one XLA program (replaces the reference's per-question
+    host loop, bicnn.lua:426-460 — quadratic host pain at real pool
+    sizes).
+
+    Each question's padded candidate pool is gathered from the answer
+    matrix and scored with the *direct* GESD form — same arithmetic as
+    the host oracle :func:`gesd_np` (an expanded |q|^2+|a|^2-2qa form
+    would catastrophically cancel exactly for the near-ties that decide
+    argmax).  ``lax.map`` over question chunks bounds memory at
+    O(chunk * P * F) regardless of question count.  ``idx/mask`` encode
+    the pools (mask: candidate known to the answer space, bicnn.lua:434
+    filter), ``hit`` whether a slot's label is gold.  Ties keep the
+    LAST maximum (reference bicnn.lua:444-447), via argmax of the
+    reversed pool axis."""
+    chunk = 32
+    qf = q_emb.astype(jnp.float32)
+    af = ans_emb.astype(jnp.float32)
+    n, p = idx.shape
+    pad = (-n) % chunk
+    if pad:
+        qf = jnp.pad(qf, ((0, pad), (0, 0)))
+        idx = jnp.pad(idx, ((0, pad), (0, 0)))
+        mask = jnp.pad(mask, ((0, pad), (0, 0)))  # False: never counted
+        hit = jnp.pad(hit, ((0, pad), (0, 0)))
+
+    def score_chunk(args):
+        qc, ic, mc, hc = args  # (C, F), (C, P), (C, P), (C, P)
+        ac = af[ic]  # (C, P, F)
+        dot = jnp.einsum("cpf,cf->cp", ac, qc)
+        l2 = jnp.sqrt(jnp.maximum(
+            jnp.sum((ac - qc[:, None, :]) ** 2, axis=-1), 0.0))
+        sims = 1.0 / ((1.0 + l2) * (1.0 + jnp.exp(-(dot + 1.0))))
+        sims = jnp.where(mc, sims, -jnp.inf)
+        best = p - 1 - jnp.argmax(sims[:, ::-1], axis=1)  # LAST max
+        chosen_hit = jnp.take_along_axis(hc, best[:, None], axis=1)[:, 0]
+        return jnp.sum((chosen_hit & jnp.any(mc, axis=1)).astype(jnp.int32))
+
+    counts = jax.lax.map(score_chunk, (
+        qf.reshape(-1, chunk, qf.shape[1]),
+        idx.reshape(-1, chunk, p),
+        mask.reshape(-1, chunk, p),
+        hit.reshape(-1, chunk, p),
+    ))
+    return jnp.sum(counts)
 
 
 class BiCNNTrainer:
@@ -226,11 +275,14 @@ class BiCNNTrainer:
                 {"params": self.flat.unravel(w)}, t, l, method=BiCNN.embed
             )
         )
+        self._pool_cache: Dict[str, tuple] = {}
+        self._pool_score = jax.jit(_pool_score)
         self._vgf = self._build_vgf()
         self._optimizer = None
-        # loss-print accumulators (bicnn.lua:283, :414-418)
-        self.loss_sum = 0.0
-        self.loss_times = 0
+        # loss-print accumulators (bicnn.lua:283, :414-418).  Device
+        # scalars, fetched only at report time — a float() per step would
+        # fence the dispatch pipeline on every batch.
+        self._loss_window: List[Any] = []
         self.best = {}  # per-dataset best accuracy/epoch (bicnn.lua:505-571)
         self.epoch = 0
 
@@ -375,8 +427,10 @@ class BiCNNTrainer:
 
     # -- evaluation (test3, bicnn.lua:465-571) -------------------------------
 
-    def _embed_chunked(self, w, tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-        """Embed (N, L) in fixed-size chunks (static shapes; one compile)."""
+    def _embed_chunked(self, w, tokens: np.ndarray, lengths: np.ndarray) -> jnp.ndarray:
+        """Embed (N, L) in fixed-size chunks (static shapes; one compile).
+        Returns a device array — the scorer consumes it in place, so
+        eval never round-trips embeddings through the host."""
         chunk = int(self.cfg.eval_chunk)
         n = tokens.shape[0]
         pad = (-n) % chunk
@@ -384,11 +438,39 @@ class BiCNNTrainer:
             tokens = np.concatenate([tokens, np.repeat(tokens[:1], pad, 0)])
             lengths = np.concatenate([lengths, np.repeat(lengths[:1], pad)])
         outs = [
-            np.asarray(self._embed(w, jnp.asarray(tokens[i : i + chunk]),
-                                   jnp.asarray(lengths[i : i + chunk])))
+            self._embed(w, jnp.asarray(tokens[i : i + chunk]),
+                        jnp.asarray(lengths[i : i + chunk]))
             for i in range(0, tokens.shape[0], chunk)
         ]
-        return np.concatenate(outs)[:n]
+        return jnp.concatenate(outs)[:n]
+
+    def _pool_tables(self, eval_set: EvalSet, name: str):
+        """Padded device tables for one eval set, built once and cached
+        (pools and labels never change during a run): ``idx`` (N, P)
+        answer-matrix rows, ``mask`` slot validity (candidate known to
+        the answer space, bicnn.lua:434 filter), ``hit`` whether the
+        slot's label is gold for its question."""
+        cached = self._pool_cache.get(name)
+        if cached is not None and cached[0] is eval_set:
+            return cached[1:]
+        l2r = self.data.label2row
+        n = len(eval_set)
+        p = max((len(pool) for pool in eval_set.pools), default=1) or 1
+        idx = np.zeros((n, p), np.int32)
+        mask = np.zeros((n, p), bool)
+        hit = np.zeros((n, p), bool)
+        for i, pool in enumerate(eval_set.pools):
+            gold = set(eval_set.labels[i])
+            for j, v in enumerate(pool):
+                row = l2r.get(v)
+                if row is None:
+                    continue
+                idx[i, j] = row
+                mask[i, j] = True
+                hit[i, j] = v in gold
+        tables = (jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(hit))
+        self._pool_cache[name] = (eval_set,) + tables
+        return tables
 
     def evaluate(
         self, eval_set: EvalSet, name: str, w=None, ans_emb: Optional[np.ndarray] = None
@@ -402,18 +484,8 @@ class BiCNNTrainer:
             if ans_emb is None:
                 ans_emb = self._embed_chunked(w, data.answer_tokens, data.answer_len)
             q_emb = self._embed_chunked(w, eval_set.q_tokens, eval_set.q_len)
-            l2r = data.label2row
-            correct = 0
-            for i in range(len(eval_set)):
-                pool = [v for v in eval_set.pools[i] if v in l2r]
-                if not pool:
-                    continue
-                sims = gesd_np(q_emb[i], ans_emb[[l2r[v] for v in pool]])
-                # '>=' keeps the LAST max — reference tie-breaking
-                # (bicnn.lua:444-447).
-                best_j = max(range(len(pool)), key=lambda j: (sims[j], j))
-                if pool[best_j] in eval_set.labels[i]:
-                    correct += 1
+            idx, mask, hit = self._pool_tables(eval_set, name)
+            correct = int(self._pool_score(q_emb, ans_emb, idx, mask, hit))
             acc = correct / max(len(eval_set), 1)
         prev = self.best.get(name, (0.0, -1))
         if acc > prev[0]:
@@ -468,8 +540,11 @@ class BiCNNTrainer:
                 idx = np.concatenate([idx, order[: b - len(idx)]])
             yield idx
 
-    def step(self, idx: np.ndarray) -> float:
-        """One feval + optimizer step on the batch rows ``idx``."""
+    def step(self, idx: np.ndarray) -> jnp.ndarray:
+        """One feval + optimizer step on the batch rows ``idx``.  Returns
+        the loss as a device scalar — fetched lazily (report window,
+        epoch average) so the dispatch pipeline is never fenced
+        per-batch."""
         tr = self.data.train
         labels = [tr.labels[i] for i in idx]
         with self.tm.phase("sample"):
@@ -480,16 +555,15 @@ class BiCNNTrainer:
             self.w, loss = self.optimizer.step(
                 self.w, q, ql, ap, apl, jnp.asarray(nt), jnp.asarray(nl)
             )
-        loss = float(loss)
-        self.loss_sum += loss
-        self.loss_times += 1
-        if self.loss_times % int(self.cfg.loss_report_every) == 0:
+        self._loss_window.append(loss)
+        if len(self._loss_window) % int(self.cfg.loss_report_every) == 0:
+            # One device reduction + one fetch for the whole window.
+            avg = float(jnp.mean(jnp.stack(self._loss_window)))
             self.log.info(
                 "curr time: %.2f, training loss avg. : %.5f",
-                self.tm.elapsed() + float(self.cfg.prevtime),
-                self.loss_sum / self.loss_times,
+                self.tm.elapsed() + float(self.cfg.prevtime), avg,
             )
-            self.loss_sum, self.loss_times = 0.0, 0
+            self._loss_window.clear()
         return loss
 
     def run(self, is_last_client: bool = False) -> Dict[str, Any]:

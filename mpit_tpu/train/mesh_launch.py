@@ -49,6 +49,9 @@ MESH_LAUNCH_DEFAULTS = Config(
     dp=0,  # 0 -> inferred from device count
     shard=0,
     target_test_err=0.01,
+    stop_at_target=0,  # 1 -> stop training once target_test_err is reached
+    device_stream=0,  # 1 -> stage each epoch's batches on device up front
+    measure_throughput=0,  # 1 -> post-training steady-state samples/s leg
     dtype="float32",
     profile_dir="",
     # multi-host bootstrap (parallel.distributed.bootstrap)
@@ -150,23 +153,43 @@ def run(cfg: Config) -> dict:
     rng = np.random.default_rng(cfg.seed)
     history: List[dict] = []
     time_to_target: Optional[float] = None
+    epoch_train_s: List[float] = []  # step-loop only, per epoch
+    samples_trained = 0
     t0 = time.perf_counter()
     with profiler_trace(cfg.profile_dir):
         for epoch in range(cfg.epochs):
             order = rng.permutation(n)
             losses = []
+            t_ep = time.perf_counter()
+            if cfg.device_stream:
+                # Stage the whole epoch in HBM with one transfer; per-step
+                # batches are device-side slices.  The shuffle is still
+                # fresh every epoch — this changes where the batches are
+                # assembled, not what is trained.
+                idx = order[: steps_per_epoch * per_step]
+                shape = ((steps_per_epoch, n_dp, cfg.batch)
+                         if cfg.opt == "easgd"
+                         else (steps_per_epoch, cfg.batch))
+                x_ep = jnp.asarray(
+                    x_train[idx].reshape(*shape, -1), dtype)
+                y_ep = jnp.asarray(y_train[idx].reshape(shape))
             for step in range(steps_per_epoch):
-                idx = order[step * per_step:(step + 1) * per_step]
-                xb = jnp.asarray(x_train[idx], dtype)
-                yb = jnp.asarray(y_train[idx])
-                if cfg.opt == "easgd":
-                    xb = xb.reshape(n_dp, cfg.batch, -1)
-                    yb = yb.reshape(n_dp, cfg.batch)
+                if cfg.device_stream:
+                    xb, yb = x_ep[step], y_ep[step]
+                else:
+                    idx = order[step * per_step:(step + 1) * per_step]
+                    xb = jnp.asarray(x_train[idx], dtype)
+                    yb = jnp.asarray(y_train[idx])
+                    if cfg.opt == "easgd":
+                        xb = xb.reshape(n_dp, cfg.batch, -1)
+                        yb = yb.reshape(n_dp, cfg.batch)
                 state, loss = trainer.step(
                     state, *trainer.shard_batch(xb, yb)
                 )
                 losses.append(loss)
             avg_loss = float(jnp.mean(jnp.stack(losses)))
+            epoch_train_s.append(time.perf_counter() - t_ep)
+            samples_trained += steps_per_epoch * per_step
             test_err = float(err_fn(eval_params(state), x_test, y_test))
             at = time.perf_counter() - t0
             if time_to_target is None and test_err <= cfg.target_test_err:
@@ -177,11 +200,65 @@ def run(cfg: Config) -> dict:
             })
             log.info("epoch %d avg_loss %.5f test_err %.4f (%.1fs)",
                      epoch, avg_loss, test_err, at)
+            if cfg.stop_at_target and time_to_target is not None:
+                break
+    train_time = sum(epoch_train_s)
+    # Wall-clock throughput: epoch 0 pays jit compile, drop it when there
+    # is anything else to measure.  Includes the one loss fetch per epoch
+    # — on a tunneled platform that round-trip can dominate short epochs,
+    # which is why the steady-state leg below exists.
+    ss = epoch_train_s[1:] if len(epoch_train_s) > 1 else epoch_train_s
+    per_epoch = steps_per_epoch * per_step
+    sps = len(ss) * per_epoch / sum(ss) if ss and sum(ss) > 0 else None
+
+    sps_steady = None
+    if cfg.measure_throughput:
+        # Latency-cancelled steady-state throughput (the recipe of
+        # :mod:`mpit_tpu.utils.timing`): whole passes over one freshly
+        # shuffled epoch staged in HBM — every step sees a different
+        # batch, the per-pass fetch round-trip is differenced away, and
+        # the jits are the already-compiled training programs.
+        from mpit_tpu.utils.timing import fetch_scalar
+
+        idx = rng.permutation(n)[: steps_per_epoch * per_step]
+        shape = ((steps_per_epoch, n_dp, cfg.batch)
+                 if cfg.opt == "easgd" else (steps_per_epoch, cfg.batch))
+        x_ep = jnp.asarray(x_train[idx].reshape(*shape, -1), dtype)
+        y_ep = jnp.asarray(y_train[idx].reshape(shape))
+
+        def one_pass(st):
+            loss = None
+            for s in range(steps_per_epoch):
+                st, loss = trainer.step(
+                    st, *trainer.shard_batch(x_ep[s], y_ep[s])
+                )
+            return st, loss
+
+        def passes(k, st):
+            t0 = time.perf_counter()
+            loss = None
+            for _ in range(k):
+                st, loss = one_pass(st)
+            fetch_scalar(loss)
+            return time.perf_counter() - t0, st
+
+        _, state = passes(1, state)  # warm the fetch path
+        best = float("inf")
+        for _ in range(2):
+            t_small, state = passes(1, state)
+            t_big, state = passes(5, state)
+            best = min(best, max(t_big - t_small, 1e-12) / 4)
+        sps_steady = per_epoch / best
     return {
         "history": history,
         "final_test_err": history[-1]["test_err"] if history else None,
         "time_to_target": time_to_target,
         "elapsed": time.perf_counter() - t0,
+        "train_time": round(train_time, 3),
+        "samples_trained": samples_trained,
+        "samples_per_sec": round(sps, 1) if sps else None,
+        "samples_per_sec_steady": round(sps_steady, 1) if sps_steady else None,
+        "data_source": source,
         "mesh": {"dp": n_dp, "shard": mesh.shape["shard"]},
         "processes": pg.num_processes,
     }

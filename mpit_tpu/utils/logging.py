@@ -20,7 +20,12 @@ def get_logger(role: str = "proc", rank: int | None = None) -> logging.Logger:
     name = f"mpit[{role}{'' if rank is None else f' {rank}'}]"
     logger = logging.getLogger(name)
     if not logger.handlers:
-        handler = logging.StreamHandler(sys.stdout)
+        # MPIT_LOG_STREAM=stderr keeps stdout machine-parseable for
+        # callers whose contract is one JSON line there (bench.py).
+        stream = (sys.stderr
+                  if os.environ.get("MPIT_LOG_STREAM") == "stderr"
+                  else sys.stdout)
+        handler = logging.StreamHandler(stream)
         handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
         logger.addHandler(handler)
         logger.propagate = False

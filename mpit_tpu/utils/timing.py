@@ -1,0 +1,104 @@
+"""Honest device timing under async dispatch — and under runtimes where
+``block_until_ready`` lies.
+
+jax dispatch is async, so the standard recipe is "loop N dispatches, then
+``block_until_ready``".  On tunneled device platforms (e.g. the axon TPU
+proxy) that recipe silently breaks: ``block_until_ready`` returns before
+the device has executed anything, producing impossible numbers (a 160 MB
+elementwise sweep "measured" at 14 TB/s; an 8k matmul at 16 PFLOP/s).
+What *cannot* lie is a host fetch of result data — the value can only be
+served after the work that produces it has run, and devices execute their
+queue in order, so fetching one element of the last result fences the
+whole loop.
+
+The remaining distortion is the fixed dispatch+fetch round-trip latency
+(~100 ms through a tunnel).  :func:`timed_per_call` cancels it by timing
+two loop lengths and differencing:
+
+    t(n) = overhead + n * per_call   =>   per_call = (t(b+n) - t(b)) / n
+
+Verified on the tunneled v5e: an 8192^3 bf16 matmul measures 6.05 ms
+per call = 181.7 TFLOP/s = 92% of the chip's 197 TFLOP/s peak, where the
+block_until_ready recipe reported 0.07 ms.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+
+def fetch_scalar(out: Any) -> float:
+    """Force completion of everything queued before ``out`` by fetching a
+    single element of its first array leaf to the host."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(np.asarray(leaf[(0,) * getattr(leaf, "ndim", 0)]))
+
+
+def timed_per_call(
+    fn: Callable[..., Any],
+    *args: Any,
+    iters: int = 10,
+    base_iters: int = 1,
+    repeats: int = 3,
+) -> float:
+    """Seconds per call of ``fn(*args)`` on device, latency-cancelled.
+
+    ``fn`` is called with the same arguments every iteration; results are
+    discarded (the runtime still executes every queued call — the final
+    fetch fences them all).  The estimate is the min over ``repeats``
+    independent differencings: round-trip jitter through a tunnel is
+    several ms, so a single (t_big - t_small) can be badly wrong.
+    """
+    fetch_scalar(fn(*args))  # compile + warm
+
+    def run(n: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn(*args)
+        fetch_scalar(out)
+        return time.perf_counter() - t0
+
+    best = float("inf")
+    for _ in range(repeats):
+        t_small = run(base_iters)
+        t_big = run(base_iters + iters)
+        best = min(best, max(t_big - t_small, 1e-12) / iters)
+    return best
+
+
+def timed_chained(
+    fn: Callable[..., Any],
+    state: Any,
+    *args: Any,
+    iters: int = 10,
+    base_iters: int = 1,
+    repeats: int = 3,
+) -> float:
+    """Like :func:`timed_per_call` for state-threading calls:
+    ``state = fn(state, *args)`` each iteration.  This is the honest way
+    to time donated/in-place update kernels — calling them repeatedly on
+    the *same* buffers would either fault (donated input reuse) or force
+    the runtime to insert defensive copies that a real training loop
+    never pays."""
+    state = fn(state, *args)  # compile + warm
+    fetch_scalar(state)
+
+    def run(n: int, st: Any) -> tuple[float, Any]:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            st = fn(st, *args)
+        fetch_scalar(st)
+        return time.perf_counter() - t0, st
+
+    best = float("inf")
+    for _ in range(repeats):
+        t_small, state = run(base_iters, state)
+        t_big, state = run(base_iters + iters, state)
+        best = min(best, max(t_big - t_small, 1e-12) / iters)
+    return best

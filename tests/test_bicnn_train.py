@@ -95,6 +95,52 @@ class TestFeval:
         np.testing.assert_allclose(float(l2), want, rtol=1e-4)
 
 
+class TestDevicePoolScorer:
+    def test_matches_host_loop_oracle(self, data):
+        """The on-device pool scorer must count exactly what the
+        reference's per-question host loop counts (bicnn.lua:426-460),
+        including unknown-candidate filtering and last-max ties."""
+        from mpit_tpu.train.bicnn import gesd_np
+
+        tr = make_trainer(data, optimization="sgd")
+        for name in ("valid", "test1", "test2"):
+            es = getattr(tr.data, name)
+            ans_emb = np.asarray(tr._embed_chunked(
+                tr.w, tr.data.answer_tokens, tr.data.answer_len))
+            q_emb = np.asarray(tr._embed_chunked(tr.w, es.q_tokens, es.q_len))
+            l2r = tr.data.label2row
+            correct = 0
+            for i in range(len(es)):
+                pool = [v for v in es.pools[i] if v in l2r]
+                if not pool:
+                    continue
+                sims = gesd_np(q_emb[i], ans_emb[[l2r[v] for v in pool]])
+                best_j = max(range(len(pool)), key=lambda j: (sims[j], j))
+                if pool[best_j] in es.labels[i]:
+                    correct += 1
+            idx, mask, hit = tr._pool_tables(es, name)
+            got = int(tr._pool_score(
+                jnp.asarray(q_emb), jnp.asarray(ans_emb), idx, mask, hit))
+            assert got == correct, name
+
+    def test_empty_and_unknown_pools_score_zero(self, data):
+        tr = make_trainer(data, optimization="sgd")
+        es = tr.data.valid
+        import dataclasses as dc
+
+        broken = dc.replace(
+            es, pools=[[] if i % 2 else [10**9] for i in range(len(es))]
+        )
+        idx, mask, hit = tr._pool_tables(broken, "broken")
+        assert not bool(mask.any())
+        ans_emb = tr._embed_chunked(
+            tr.w, tr.data.answer_tokens, tr.data.answer_len)
+        q_emb = tr._embed_chunked(tr.w, es.q_tokens, es.q_len)
+        got = int(tr._pool_score(
+            jnp.asarray(q_emb), jnp.asarray(ans_emb), idx, mask, hit))
+        assert got == 0
+
+
 class TestLocalTraining:
     def test_sgd_learns_above_chance(self, data):
         tr = make_trainer(data, optimization="sgd", learning_rate=0.05,

@@ -1,0 +1,39 @@
+"""Tests for the latency-cancelled device timing helper."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.utils.timing import fetch_scalar, timed_per_call
+
+
+def test_fetch_scalar_forces_value():
+    x = jnp.arange(8.0)
+    assert fetch_scalar(jax.jit(lambda a: a * 2)(x)) == 0.0
+    assert fetch_scalar((jnp.float32(3.0), jnp.zeros(4))) == 3.0
+
+
+def test_timed_per_call_positive_and_finite():
+    f = jax.jit(lambda a: a @ a)
+    x = jnp.ones((64, 64))
+    t = timed_per_call(f, x, iters=3)
+    assert np.isfinite(t) and t > 0
+
+
+def test_timed_per_call_scales_with_work():
+    # 8x the matmul work should cost measurably more per call; the only
+    # claim tested is monotonicity with a wide margin, not absolute time.
+    f = jax.jit(lambda a: a @ a)
+    small = jnp.ones((128, 128))
+    big = jnp.ones((1024, 1024))
+    t_small = min(timed_per_call(f, small, iters=20) for _ in range(3))
+    t_big = min(timed_per_call(f, big, iters=20) for _ in range(3))
+    assert t_big > t_small
+
+
+def test_timed_per_call_rejects_zero_division():
+    # Degenerate fast fn must not return <= 0 (the max(..., eps) guard).
+    f = jax.jit(lambda a: a)
+    t = timed_per_call(f, jnp.zeros(1), iters=2)
+    assert t > 0
