@@ -202,6 +202,127 @@ class HostCollectives:
             )
         return arr
 
+    def allgather(self, send: np.ndarray, recv: np.ndarray) -> np.ndarray:
+        """Equal-block allgather (reference mpifuncs.c:47): every rank's
+        ``send`` block lands in ``recv`` at block offset == its rank.
+        Ring circulation, ``n-1`` neighbor steps (bandwidth-optimal)."""
+        sflat, rflat = self._flat(send), self._flat(recv)
+        if rflat.size != sflat.size * self.n:
+            raise ValueError(
+                f"allgather recv must hold n*send ({self.n}x{sflat.size}), "
+                f"got {rflat.size}"
+            )
+        block = lambda i: rflat[(i % self.n) * sflat.size:
+                                (i % self.n + 1) * sflat.size]
+        np.copyto(block(self.rank), sflat)
+        if self.n == 1:
+            return recv
+        tag = self._tags()
+        right, left = (self.rank + 1) % self.n, (self.rank - 1) % self.n
+        for s in range(self.n - 1):
+            self._sendrecv(block(self.rank - s), right,
+                           block(self.rank - s - 1), left, tag(s), tag(s))
+        return recv
+
+    def reduce_scatter(self, arr: np.ndarray, out: np.ndarray,
+                       op: str = "sum") -> np.ndarray:
+        """Equal-block reduce-scatter (reference mpifuncs.c:1716,
+        Reduce_scatter_block semantics): ``arr`` is n equal blocks; rank r
+        receives the elementwise reduction of every rank's block r in
+        ``out``.  The ring reduce-scatter phase of :meth:`allreduce`;
+        ``arr`` is scratch afterwards."""
+        fold = _OPS[op]
+        flat, oflat = self._flat(arr), self._flat(out)
+        if flat.size != oflat.size * self.n:
+            raise ValueError(
+                f"reduce_scatter arr must be n*out ({self.n}x{oflat.size}), "
+                f"got {flat.size}"
+            )
+        if self.n == 1:
+            np.copyto(oflat, flat)
+            return out
+        tag = self._tags()
+        n, r = self.n, self.rank
+        right, left = (r + 1) % n, (r - 1) % n
+        size = oflat.size
+        chunk = lambda i: flat[(i % n) * size:(i % n + 1) * size]
+        tmp = np.empty(size, flat.dtype)
+        # After n-1 steps rank r holds the full sum of chunk (r+1) mod n
+        # (same schedule as allreduce); one extra neighbor hop rehomes it
+        # so rank r's out is chunk r, the MPI contract.
+        for s in range(n - 1):
+            sc, rc = (r - s) % n, (r - s - 1) % n
+            self._sendrecv(chunk(sc), right, tmp, left, tag(s), tag(s))
+            fold(chunk(rc), tmp)
+        self._sendrecv(chunk(r + 1), right, oflat, left,
+                       tag(n - 1), tag(n - 1))
+        return out
+
+    def scatter(self, arr: Optional[np.ndarray], out: np.ndarray,
+                root: int = 0) -> np.ndarray:
+        """Equal-block scatter from ``root`` (reference mpifuncs.c:1792):
+        block i of root's ``arr`` lands in rank i's ``out``."""
+        oflat = self._flat(out)
+        tag = self._tags()
+        if self.rank == root:
+            flat = self._flat(arr)
+            if flat.size != oflat.size * self.n:
+                raise ValueError(
+                    f"scatter arr must be n*out ({self.n}x{oflat.size}), "
+                    f"got {flat.size}"
+                )
+            size = oflat.size
+            handles = [
+                self.t.isend(flat[i * size:(i + 1) * size], i, tag(0))
+                for i in range(self.n) if i != root
+            ]
+            np.copyto(oflat, flat[root * size:(root + 1) * size])
+            self._drive(*handles)
+        else:
+            self._recv(oflat, root, tag(0))
+        return out
+
+    def gather(self, send: np.ndarray, recv: Optional[np.ndarray],
+               root: int = 0) -> Optional[np.ndarray]:
+        """Equal-block gather onto ``root`` (reference mpifuncs.c:1265):
+        rank i's ``send`` lands in block i of root's ``recv``."""
+        sflat = self._flat(send)
+        tag = self._tags()
+        if self.rank == root:
+            rflat = self._flat(recv)
+            if rflat.size != sflat.size * self.n:
+                raise ValueError(
+                    f"gather recv must hold n*send ({self.n}x{sflat.size}), "
+                    f"got {rflat.size}"
+                )
+            size = sflat.size
+            handles = [
+                self.t.irecv(i, tag(0), out=rflat[i * size:(i + 1) * size])
+                for i in range(self.n) if i != root
+            ]
+            np.copyto(rflat[root * size:(root + 1) * size], sflat)
+            self._drive(*handles)
+            return recv
+        self._send(sflat, root, tag(0))
+        return None
+
+    def scan(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        """Inclusive prefix reduction (reference mpifuncs.c:1780 MPI_Scan):
+        rank r ends with fold(rank 0..r inputs), in place.  Linear chain —
+        latency n-1 hops, which is fine at role-process counts."""
+        fold = _OPS[op]
+        flat = self._flat(arr)
+        if self.n == 1:
+            return arr
+        tag = self._tags()
+        if self.rank > 0:
+            tmp = np.empty_like(flat)
+            self._recv(tmp, self.rank - 1, tag(self.rank - 1))
+            fold(flat, tmp)
+        if self.rank + 1 < self.n:
+            self._send(flat, self.rank + 1, tag(self.rank))
+        return arr
+
     def allreduce_async(self, arr: np.ndarray, op: str = "sum"):
         """Nonblocking allreduce (reference mpifuncs.c:1357 Iallreduce;
         Test-before/after-Wait shape of test/testireduceall.lua:32-39).

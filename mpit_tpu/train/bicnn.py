@@ -279,10 +279,13 @@ class BiCNNTrainer:
         self._pool_score = jax.jit(_pool_score)
         self._vgf = self._build_vgf()
         self._optimizer = None
-        # loss-print accumulators (bicnn.lua:283, :414-418).  Device
-        # scalars, fetched only at report time — a float() per step would
-        # fence the dispatch pipeline on every batch.
-        self._loss_window: List[Any] = []
+        # loss-print accumulators (bicnn.lua:283, :414-418).  A running
+        # *device* scalar sum, fetched only at report time — a float()
+        # per step would fence the dispatch pipeline on every batch, and
+        # a list of per-step scalars would grow without bound when
+        # reporting is disabled.
+        self._loss_acc: Any = None
+        self._loss_count = 0
         self.best = {}  # per-dataset best accuracy/epoch (bicnn.lua:505-571)
         self.epoch = 0
 
@@ -555,15 +558,16 @@ class BiCNNTrainer:
             self.w, loss = self.optimizer.step(
                 self.w, q, ql, ap, apl, jnp.asarray(nt), jnp.asarray(nl)
             )
-        self._loss_window.append(loss)
-        if len(self._loss_window) % int(self.cfg.loss_report_every) == 0:
-            # One device reduction + one fetch for the whole window.
-            avg = float(jnp.mean(jnp.stack(self._loss_window)))
+        self._loss_acc = loss if self._loss_acc is None else self._loss_acc + loss
+        self._loss_count += 1
+        if self._loss_count % int(self.cfg.loss_report_every) == 0:
+            # One fetch for the whole window.
             self.log.info(
                 "curr time: %.2f, training loss avg. : %.5f",
-                self.tm.elapsed() + float(self.cfg.prevtime), avg,
+                self.tm.elapsed() + float(self.cfg.prevtime),
+                float(self._loss_acc) / self._loss_count,
             )
-            self._loss_window.clear()
+            self._loss_acc, self._loss_count = None, 0
         return loss
 
     def run(self, is_last_client: bool = False) -> Dict[str, Any]:
@@ -581,9 +585,11 @@ class BiCNNTrainer:
             self.epoch = epoch
             t_epoch = time.monotonic()
             order = self.rng.permutation(n)  # shuffle (bicnn.lua:609)
-            losses = []
+            loss_sum, steps = None, 0
             for idx in self._batches(order):
-                losses.append(self.step(idx))
+                loss = self.step(idx)
+                loss_sum = loss if loss_sum is None else loss_sum + loss
+                steps += 1
                 # lastClient in-train testing every commperiod steps
                 # (bicnn.lua:625-633).
                 if (
@@ -596,7 +602,8 @@ class BiCNNTrainer:
                 pversion += 1
             history.append({
                 "epoch": epoch,
-                "avg_loss": float(np.mean(losses)) if losses else 0.0,
+                # One fetch per epoch (not one per step).
+                "avg_loss": float(loss_sum) / steps if steps else 0.0,
                 "seconds": time.monotonic() - t_epoch,
             })
             self.log.info(

@@ -213,12 +213,13 @@ def run(cfg: Config) -> dict:
 
     sps_steady = None
     if cfg.measure_throughput:
-        # Latency-cancelled steady-state throughput (the recipe of
-        # :mod:`mpit_tpu.utils.timing`): whole passes over one freshly
-        # shuffled epoch staged in HBM — every step sees a different
-        # batch, the per-pass fetch round-trip is differenced away, and
-        # the jits are the already-compiled training programs.
-        from mpit_tpu.utils.timing import fetch_scalar
+        # Latency-cancelled steady-state throughput
+        # (:func:`mpit_tpu.utils.timing.timed_chained`): whole passes
+        # over one freshly shuffled epoch staged in HBM — every step
+        # sees a different batch, the per-pass fetch round-trip is
+        # differenced away, and the jits are the already-compiled
+        # training programs.
+        from mpit_tpu.utils.timing import timed_chained
 
         idx = rng.permutation(n)[: steps_per_epoch * per_step]
         shape = ((steps_per_epoch, n_dp, cfg.batch)
@@ -227,28 +228,16 @@ def run(cfg: Config) -> dict:
         y_ep = jnp.asarray(y_train[idx].reshape(shape))
 
         def one_pass(st):
-            loss = None
             for s in range(steps_per_epoch):
-                st, loss = trainer.step(
+                st, _loss = trainer.step(
                     st, *trainer.shard_batch(x_ep[s], y_ep[s])
                 )
-            return st, loss
+            return st
 
-        def passes(k, st):
-            t0 = time.perf_counter()
-            loss = None
-            for _ in range(k):
-                st, loss = one_pass(st)
-            fetch_scalar(loss)
-            return time.perf_counter() - t0, st
-
-        _, state = passes(1, state)  # warm the fetch path
-        best = float("inf")
-        for _ in range(2):
-            t_small, state = passes(1, state)
-            t_big, state = passes(5, state)
-            best = min(best, max(t_big - t_small, 1e-12) / 4)
-        sps_steady = per_epoch / best
+        per_pass = timed_chained(
+            one_pass, state, iters=4, base_iters=1, repeats=2
+        )
+        sps_steady = per_epoch / per_pass
     return {
         "history": history,
         "final_test_err": history[-1]["test_err"] if history else None,

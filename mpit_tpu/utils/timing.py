@@ -50,9 +50,11 @@ def timed_per_call(
 
     ``fn`` is called with the same arguments every iteration; results are
     discarded (the runtime still executes every queued call — the final
-    fetch fences them all).  The estimate is the min over ``repeats``
-    independent differencings: round-trip jitter through a tunnel is
-    several ms, so a single (t_big - t_small) can be badly wrong.
+    fetch fences them all).  Each leg is measured ``repeats`` times and
+    the difference is taken between the per-leg minima: jitter is
+    additive-positive, so min() per leg filters it, whereas min over
+    *differences* would lock in exactly the repeat whose short leg
+    caught a spike (an overestimate of speed).
     """
     fetch_scalar(fn(*args))  # compile + warm
 
@@ -64,12 +66,9 @@ def timed_per_call(
         fetch_scalar(out)
         return time.perf_counter() - t0
 
-    best = float("inf")
-    for _ in range(repeats):
-        t_small = run(base_iters)
-        t_big = run(base_iters + iters)
-        best = min(best, max(t_big - t_small, 1e-12) / iters)
-    return best
+    t_small = min(run(base_iters) for _ in range(repeats))
+    t_big = min(run(base_iters + iters) for _ in range(repeats))
+    return max(t_big - t_small, 1e-12) / iters
 
 
 def timed_chained(
@@ -85,7 +84,7 @@ def timed_chained(
     to time donated/in-place update kernels — calling them repeatedly on
     the *same* buffers would either fault (donated input reuse) or force
     the runtime to insert defensive copies that a real training loop
-    never pays."""
+    never pays.  Per-leg minima, as in :func:`timed_per_call`."""
     state = fn(state, *args)  # compile + warm
     fetch_scalar(state)
 
@@ -96,9 +95,10 @@ def timed_chained(
         fetch_scalar(st)
         return time.perf_counter() - t0, st
 
-    best = float("inf")
+    smalls, bigs = [], []
     for _ in range(repeats):
         t_small, state = run(base_iters, state)
+        smalls.append(t_small)
         t_big, state = run(base_iters + iters, state)
-        best = min(best, max(t_big - t_small, 1e-12) / iters)
-    return best
+        bigs.append(t_big)
+    return max(min(bigs) - min(smalls), 1e-12) / iters

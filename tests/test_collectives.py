@@ -125,6 +125,70 @@ class TestHostCollectives:
         for mx, sm in run_ranks(N, body):
             assert mx == N - 1 and sm == sum(range(N))
 
+    @pytest.mark.parametrize("block", [3, 512])
+    def test_allgather(self, rng, block):
+        inputs = [rng.normal(size=block).astype(np.float32) for _ in range(N)]
+        want = np.concatenate(inputs)
+
+        def body(coll, r):
+            recv = np.empty(N * block, np.float32)
+            coll.allgather(inputs[r].copy(), recv)
+            return recv
+
+        for recv in run_ranks(N, body):
+            np.testing.assert_allclose(recv, want)
+
+    @pytest.mark.parametrize("block", [3, 512])
+    def test_reduce_scatter(self, rng, block):
+        inputs = [rng.normal(size=N * block).astype(np.float32)
+                  for _ in range(N)]
+        want = np.sum(np.stack(inputs), axis=0)
+
+        def body(coll, r):
+            out = np.empty(block, np.float32)
+            coll.reduce_scatter(inputs[r].copy(), out)
+            return out
+
+        for r, out in enumerate(run_ranks(N, body)):
+            np.testing.assert_allclose(
+                out, want[r * block:(r + 1) * block], rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("root", [0, 2])
+    def test_scatter_gather_roundtrip(self, rng, root):
+        src = rng.normal(size=N * 16).astype(np.float32)
+
+        def body(coll, r):
+            out = np.empty(16, np.float32)
+            coll.scatter(src.copy() if r == root else None, out, root=root)
+            back = (np.empty(N * 16, np.float32) if r == root else None)
+            coll.gather(out * 2.0, back, root=root)
+            return out, back
+
+        results = run_ranks(N, body)
+        for r, (out, _) in enumerate(results):
+            np.testing.assert_allclose(out, src[r * 16:(r + 1) * 16])
+        np.testing.assert_allclose(results[root][1], src * 2.0)
+
+    def test_scan_inclusive_prefix(self, rng):
+        inputs = [rng.normal(size=64).astype(np.float32) for _ in range(N)]
+
+        def body(coll, r):
+            arr = inputs[r].copy()
+            coll.scan(arr)
+            return arr
+
+        for r, arr in enumerate(run_ranks(N, body)):
+            want = np.sum(np.stack(inputs[: r + 1]), axis=0)
+            np.testing.assert_allclose(arr, want, rtol=1e-4, atol=1e-5)
+
+    def test_block_size_validation(self):
+        router = LocalRouter(1)
+        coll = HostCollectives(router.endpoint(0))
+        with pytest.raises(ValueError, match="n\\*send"):
+            coll.allgather(np.zeros(4, np.float32), np.zeros(5, np.float32))
+        with pytest.raises(ValueError, match="n\\*out"):
+            coll.reduce_scatter(np.zeros(5, np.float32), np.zeros(4, np.float32))
+
     def test_rejects_noncontiguous(self):
         router = LocalRouter(1)
         coll = HostCollectives(router.endpoint(0))
