@@ -105,6 +105,10 @@ class TcpTransport(Transport):
         # are cancelled immediately instead of queueing into a box nobody
         # drains.
         self._dead_peers: set = set()
+        # Peers whose reader has died mid-run: pending receives with no
+        # message to match fail loudly (raise-once from test) instead of
+        # polling forever on a connection that can never deliver.
+        self._dead_readers: set = set()
         self._threads: List[threading.Thread] = []
         self._closed = False
 
@@ -174,6 +178,27 @@ class TcpTransport(Transport):
                     self._channels[(peer, int(tag))].msgs.append(payload)
         except OSError:
             return  # socket torn down by close()
+        finally:
+            if not self._closed:
+                self._fail_unmatched_recvs(peer)
+
+    def _fail_unmatched_recvs(self, peer: int) -> None:
+        """A mid-run reader death (peer crashed / link dropped): every
+        pending recv beyond the already-delivered backlog can never
+        complete — fail them with the raise-once convention, and make
+        later irecvs from this peer fail the same way.  Messages that
+        arrived before the death still serve matching receives (same
+        drain-what-landed semantics as the shm transport's remap)."""
+        err = f"recv from rank {peer} failed: connection lost"
+        with self._lock:
+            self._dead_readers.add(peer)
+            for (src, _tag), chan in self._channels.items():
+                if src != peer:
+                    continue
+                live = [h for h in chan.pending if not h.cancelled]
+                for h in live[len(chan.msgs):]:
+                    h.cancelled = True
+                    h.meta["error"] = err
 
     def _writer(self, peer: int, conn: socket.socket) -> None:
         cv = self._out_cv[peer]
@@ -252,12 +277,33 @@ class TcpTransport(Transport):
         if out is None:
             handle.meta["as_bytes"] = True
         with self._lock:
-            self._channels[(src, tag)].pending.append(handle)
+            chan = self._channels[(src, tag)]
+            if src in self._dead_readers:
+                # Only the already-delivered backlog can satisfy receives.
+                live = sum(1 for h in chan.pending if not h.cancelled)
+                if live >= len(chan.msgs):
+                    handle.cancelled = True
+                    handle.meta["error"] = (
+                        f"recv from rank {src} failed: connection lost"
+                    )
+                    return handle
+            chan.pending.append(handle)
         return handle
 
     def iprobe(self, src: int, tag: int) -> bool:
         with self._lock:
-            return bool(self._channels[(src, tag)].msgs)
+            if self._channels[(src, tag)].msgs:
+                return True
+            if src in self._dead_readers:
+                # A probe loop on a dead, drained channel can never turn
+                # true — fail loudly (the aio schedulers' probe-then-recv
+                # pattern, aio/scheduler.py, would otherwise poll forever;
+                # the error surfaces from Scheduler.wait with the task
+                # attached).
+                raise RuntimeError(
+                    f"recv from rank {src} failed: connection lost"
+                )
+            return False
 
     def test(self, handle: Handle) -> bool:
         if handle.cancelled:

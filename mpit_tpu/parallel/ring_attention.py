@@ -415,8 +415,8 @@ def ring_attention(
     causal: bool = True,
     sm_scale: float | None = None,
     impl: str = "auto",
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: bool | None = None,
     precision: str | None = None,
     layout: str = "contiguous",

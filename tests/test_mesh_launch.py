@@ -31,6 +31,29 @@ def test_syncdp_trains_to_target():
     assert res["time_to_target"] is not None
 
 
+@pytest.mark.parametrize("opt,kw", [
+    ("easgd", dict(su=2, mva=0.2, lr=0.1, mom=0.9)),
+    ("syncdp", dict(lr=0.2, mom=0.9, batch=64)),
+])
+def test_device_stream_trains_identically(opt, kw):
+    """Staging an epoch in HBM must change where batches are assembled,
+    not what is trained: same seed -> same per-epoch losses and errors
+    as the per-step host path."""
+    host = run(_tiny_cfg(opt=opt, **kw))
+    staged = run(_tiny_cfg(opt=opt, device_stream=1, **kw))
+    for h, s in zip(host["history"], staged["history"]):
+        np.testing.assert_allclose(s["avg_loss"], h["avg_loss"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(s["test_err"], h["test_err"], atol=1e-6)
+
+
+def test_measure_throughput_reports_steady_rate():
+    res = run(_tiny_cfg(opt="easgd", su=2, mva=0.2, lr=0.1, mom=0.9,
+                        epochs=1, measure_throughput=1))
+    assert res["samples_per_sec_steady"] is not None
+    assert res["samples_per_sec_steady"] > 0
+
+
 def test_bad_opt_raises():
     with pytest.raises(ValueError, match="easgd|syncdp"):
         run(_tiny_cfg(opt="adamw"))

@@ -4,9 +4,11 @@ zero-byte header/ack), a real cross-process run, and the full PS stack
 over TCP."""
 
 import os
+import socket
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -144,6 +146,47 @@ class TestTcpTransport:
         with pytest.raises(RuntimeError, match="unreachable"):
             a.test(h)
         assert a.test(h) is False  # raise-once, then quiet not-done
+
+    def test_peer_crash_fails_blocked_recvs(self):
+        """A mid-run peer death must fail pending receives loudly (the
+        raise-once convention), not leave them polling forever; messages
+        delivered before the crash still serve matching receives."""
+        a, b = make_mesh_transports(2)
+        try:
+            # One message lands before the crash...
+            hs = b.isend(np.arange(3, dtype=np.float32), 0, 7)
+            deadline = time.monotonic() + 10
+            while not a.iprobe(1, 7):
+                assert time.monotonic() < deadline, "delivery hung"
+            assert b.test(hs)
+            # ...then rank 1 dies (simulated: close without orderly flag).
+            for conn in b._peers.values():
+                conn.shutdown(socket.SHUT_RDWR)
+            h_served = a.irecv(1, 7, out=np.empty(3, np.float32))
+            h_starved = a.irecv(1, 7, out=np.empty(3, np.float32))
+            deadline = time.monotonic() + 10
+            while not a.test(h_served):
+                assert time.monotonic() < deadline, "backlog recv hung"
+            # The starved recv fails loudly once the reader notices.
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    assert not a.test(h_starved)
+                except RuntimeError as e:
+                    assert "connection lost" in str(e)
+                    break
+                assert time.monotonic() < deadline, "starved recv never failed"
+            # New receives from the dead peer fail immediately.
+            h_new = a.irecv(1, 9)
+            with pytest.raises(RuntimeError, match="connection lost"):
+                a.test(h_new)
+            # Probe loops (the aio probe-then-recv pattern) fail loudly
+            # too once the channel is drained.
+            with pytest.raises(RuntimeError, match="connection lost"):
+                a.iprobe(1, 11)
+        finally:
+            a.close()
+            b.close()
 
     def test_close_cancels_queued_sends(self):
         """No orphaned handles: after close every send handle is done or
