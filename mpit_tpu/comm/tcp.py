@@ -43,6 +43,11 @@ from mpit_tpu.comm.transport import (
 
 _HDR = struct.Struct("<qq")  # tag, size
 _RANK_HDR = struct.Struct("<q")
+# Reserved wire tag: an orderly close() announces itself so the peer's
+# reader can distinguish graceful shutdown (old silent-cancel semantics)
+# from a crash (fail-loud semantics).  User tags are non-negative
+# (ps/tags.py, collectives' 2^16+ range), so the sentinel can't collide.
+_GOODBYE_TAG = -(1 << 62)
 
 
 def allocate_local_addresses(nranks: int) -> Tuple[List[str], List[socket.socket]]:
@@ -165,12 +170,16 @@ class TcpTransport(Transport):
         self._threads.append(t)
 
     def _reader(self, peer: int, conn: socket.socket) -> None:
+        graceful = False
         try:
             while True:
                 hdr = _recv_exact(conn, _HDR.size)
                 if hdr is None:
                     return
                 tag, size = _HDR.unpack(hdr)
+                if tag == _GOODBYE_TAG:
+                    graceful = True  # peer is closing in an orderly way
+                    return
                 payload = _recv_exact(conn, int(size)) if size else b""
                 if payload is None:
                     return
@@ -179,7 +188,7 @@ class TcpTransport(Transport):
         except OSError:
             return  # socket torn down by close()
         finally:
-            if not self._closed:
+            if not graceful and not self._closed:
                 self._fail_unmatched_recvs(peer)
 
     def _fail_unmatched_recvs(self, peer: int) -> None:
@@ -349,8 +358,31 @@ class TcpTransport(Transport):
     def close(self) -> None:
         if self._closed:
             return
+        # Goodbye frames: queue one to every live peer (FIFO after any
+        # still-queued user sends) and give the writers a bounded grace
+        # period to flush, so readers on the other side see an orderly
+        # shutdown rather than a crash.  Best-effort: a dead or
+        # backlogged peer just misses the goodbye and reports
+        # connection-lost, which is accurate for it.
+        zero = np.empty(0, np.uint8)
+        for peer in range(self.nranks):
+            if peer == self.rank:
+                continue
+            cv = self._out_cv[peer]
+            with cv:
+                if peer not in self._dead_peers:
+                    self._outboxes[peer].append(
+                        (Handle(kind="send", peer=peer, tag=_GOODBYE_TAG),
+                         _HDR.pack(_GOODBYE_TAG, 0), zero.view())
+                    )
+                    cv.notify()
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline and any(
+            self._outboxes[p] for p in range(self.nranks) if p != self.rank
+        ):
+            time.sleep(0.005)
         self._closed = True
-        # Cancel every queued send first — a blocking sender must observe
+        # Cancel every queued send left — a blocking sender must observe
         # done-or-cancelled, never an orphaned handle.
         for peer in range(self.nranks):
             if peer != self.rank:

@@ -208,10 +208,23 @@ def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
             ).astype(o_ref.dtype)
 
 
-def _tile_dims(lq, lk, d, block_q, block_k, sm_scale):
+def _default_blocks(dtype) -> Tuple[int, int]:
+    """Dtype-aware default tiles, chosen by on-chip sweep
+    (docs/KERNEL_BENCH.md): 1024x1024 for <=2-byte inputs (2.7x faster
+    than the old 256x512); 512x512 for f32 — the f32 backward at
+    1024-blocks sits at the scoped-VMEM edge and crashes the TPU
+    compiler inside larger programs (docs/tpu_compile_notes.md)."""
+    return (1024, 1024) if jnp.dtype(dtype).itemsize <= 2 else (512, 512)
+
+
+def _tile_dims(lq, lk, d, block_q, block_k, sm_scale, dtype):
     """Shared forward/backward tiling contract: softmax scale, clamped
     block sizes and padded dims.  The backward's saved-LSE rows only line
-    up with recomputed score tiles if both directions use exactly this."""
+    up with recomputed score tiles if both directions use exactly this.
+    ``block_q``/``block_k`` of None resolve to the dtype default."""
+    dq, dk = _default_blocks(dtype)
+    block_q = dq if block_q is None else block_q
+    block_k = dk if block_k is None else block_k
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     bq = min(block_q, _round_up(lq, 8))
     bk = min(block_k, _round_up(lk, LANE))
@@ -233,7 +246,7 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
     lq, d = q.shape
     lk = k.shape[0]
     scale, bq, bk, lq_p, lk_p, d_p = _tile_dims(
-        lq, lk, d, block_q, block_k, sm_scale
+        lq, lk, d, block_q, block_k, sm_scale, q.dtype
     )
     qp = jnp.pad(q, ((0, lq_p - lq), (0, d_p - d)))
     kp = jnp.pad(k, ((0, lk_p - lk), (0, d_p - d)))
@@ -293,8 +306,8 @@ def flash_attention_partial(
     sm_scale: float | None = None,
     q_offset=0,
     kv_offset=0,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
     precision: str | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -452,7 +465,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
     lq, d = q.shape
     lk = k.shape[0]
     scale, bq, bk, lq_p, lk_p, d_p = _tile_dims(
-        lq, lk, d, block_q, block_k, sm_scale
+        lq, lk, d, block_q, block_k, sm_scale, q.dtype
     )
     qp = jnp.pad(q, ((0, lq_p - lq), (0, d_p - d)))
     kp = jnp.pad(k, ((0, lk_p - lk), (0, d_p - d)))
@@ -511,7 +524,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
 
 def flash_attention_bwd_pair(q, k, v, do, lse, *, causal=False, sm_scale=None,
                              q_offset=0, kv_offset=0, delta=None, o=None,
-                             block_q=1024, block_k=1024, interpret=None,
+                             block_q=None, block_k=None, interpret=None,
                              precision=None):
     """Pallas flash backward for one (Q chunk, KV chunk) pair over
     ``(..., L, D)``: returns ``(dq, dk, dv)`` given the forward's row
@@ -583,8 +596,8 @@ def flash_attention(
     sm_scale: float | None = None,
     q_offset=0,
     kv_offset=0,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
     precision: str | None = None,
 ) -> jnp.ndarray:
@@ -605,7 +618,8 @@ def flash_attention(
     # entries).
     fa = _make_flash(bool(causal),
                      None if sm_scale is None else float(sm_scale),
-                     int(block_q), int(block_k),
+                     None if block_q is None else int(block_q),
+                     None if block_k is None else int(block_k),
                      _interpret(interpret), precision)
     return fa(q, k, v, jnp.asarray(q_offset, jnp.int32),
               jnp.asarray(kv_offset, jnp.int32))

@@ -415,8 +415,8 @@ def ring_attention(
     causal: bool = True,
     sm_scale: float | None = None,
     impl: str = "auto",
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
     precision: str | None = None,
     layout: str = "contiguous",
@@ -453,7 +453,9 @@ def ring_attention(
         axis, n, bool(causal),
         # Static cache key: reject traced sm_scale with a clear error.
         None if sm_scale is None else float(sm_scale),
-        impl, int(block_q), int(block_k),
+        impl,
+        None if block_q is None else int(block_q),
+        None if block_k is None else int(block_k),
         interpret, precision, layout,
     )
 

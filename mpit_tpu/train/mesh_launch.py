@@ -24,6 +24,7 @@ Example (single host, all local devices):
 from __future__ import annotations
 
 import json
+import pathlib
 import sys
 import time
 from typing import List, Optional
@@ -52,6 +53,9 @@ MESH_LAUNCH_DEFAULTS = Config(
     stop_at_target=0,  # 1 -> stop training once target_test_err is reached
     device_stream=0,  # 1 -> stage each epoch's batches on device up front
     measure_throughput=0,  # 1 -> post-training steady-state samples/s leg
+    ckpt_dir="",  # save full trainer state every ckpt_every epochs
+    ckpt_every=1,
+    resume="",  # path to a mesh_*.npz (or "auto": <ckpt_dir>/mesh_latest.npz)
     dtype="float32",
     profile_dir="",
     # multi-host bootstrap (parallel.distributed.bootstrap)
@@ -129,6 +133,52 @@ def run(cfg: Config) -> dict:
         raise ValueError(f"opt must be easgd|syncdp, got {cfg.opt!r}")
     state = trainer.init(flat.w0.astype(dtype))
 
+    if (cfg.ckpt_dir or cfg.resume) and pg.num_processes > 1:
+        # Host-local numpy round-trips of globally-sharded state are
+        # invalid across processes, and every host would race the same
+        # _latest publish.  Fail at config time, not at first save.
+        raise ValueError(
+            "--ckpt_dir/--resume are single-process only for now "
+            "(multi-host checkpointing needs per-process shard IO)"
+        )
+    start_epoch = 0
+    prev_elapsed = 0.0  # cumulative training seconds from resumed runs
+    resume_path = cfg.resume
+    if resume_path == "auto":
+        if not cfg.ckpt_dir:
+            raise ValueError("--resume auto requires --ckpt_dir")
+        resume_path = str(pathlib.Path(cfg.ckpt_dir) / "mesh_latest.npz")
+    if resume_path:
+        from mpit_tpu.utils.checkpoint import load_state_dict
+
+        saved, ck_meta = load_state_dict(resume_path)
+        if set(saved) != set(state):
+            raise ValueError(
+                f"checkpoint keys {sorted(saved)} do not match trainer "
+                f"state {sorted(state)} — wrong --opt or model?"
+            )
+        if "seed" in ck_meta and int(ck_meta["seed"]) != int(cfg.seed):
+            raise ValueError(
+                f"checkpoint was trained with --seed {ck_meta['seed']}, "
+                f"resuming with --seed {cfg.seed} would silently diverge "
+                "the data order — pass the original seed"
+            )
+        # Re-place each array with its mesh sharding (init produced the
+        # placement template; shapes must match exactly).
+        for key, arr in saved.items():
+            if tuple(arr.shape) != tuple(state[key].shape):
+                raise ValueError(
+                    f"checkpoint {key} shape {arr.shape} != trainer "
+                    f"{tuple(state[key].shape)} (different mesh/model?)"
+                )
+            state[key] = jax.device_put(
+                jnp.asarray(arr), state[key].sharding
+            )
+        start_epoch = int(ck_meta.get("epoch", -1)) + 1
+        prev_elapsed = float(ck_meta.get("elapsed", 0.0))
+        log.info("resumed from %s at epoch %d (%.1fs of prior training)",
+                 resume_path, start_epoch, prev_elapsed)
+
     err_fn = jax.jit(
         lambda w, xb, yb: jnp.mean(
             (jnp.argmax(flat.apply_flat(w, xb), axis=1) != yb).astype(jnp.float32)
@@ -156,8 +206,12 @@ def run(cfg: Config) -> dict:
     epoch_train_s: List[float] = []  # step-loop only, per epoch
     samples_trained = 0
     t0 = time.perf_counter()
+    # Resume reproducibility: burn the skipped epochs' permutations so
+    # the data order continues exactly where the checkpointed run left it.
+    for _ in range(start_epoch):
+        rng.permutation(n)
     with profiler_trace(cfg.profile_dir):
-        for epoch in range(cfg.epochs):
+        for epoch in range(start_epoch, cfg.epochs):
             order = rng.permutation(n)
             losses = []
             t_ep = time.perf_counter()
@@ -191,7 +245,10 @@ def run(cfg: Config) -> dict:
             epoch_train_s.append(time.perf_counter() - t_ep)
             samples_trained += steps_per_epoch * per_step
             test_err = float(err_fn(eval_params(state), x_test, y_test))
-            at = time.perf_counter() - t0
+            # Cumulative across resumes (the reference's prevtime
+            # convention, bicnn.lua:259-261) so time_to_target stays the
+            # true wall-clock from the ORIGINAL start.
+            at = time.perf_counter() - t0 + prev_elapsed
             if time_to_target is None and test_err <= cfg.target_test_err:
                 time_to_target = at
             history.append({
@@ -200,6 +257,17 @@ def run(cfg: Config) -> dict:
             })
             log.info("epoch %d avg_loss %.5f test_err %.4f (%.1fs)",
                      epoch, avg_loss, test_err, at)
+            if cfg.ckpt_dir and (epoch + 1) % max(int(cfg.ckpt_every), 1) == 0:
+                from mpit_tpu.utils.checkpoint import save_state_dict
+
+                path = save_state_dict(
+                    cfg.ckpt_dir,
+                    {k: np.asarray(v) for k, v in state.items()},
+                    meta={"epoch": epoch, "opt": cfg.opt,
+                          "test_err": test_err, "seed": cfg.seed,
+                          "elapsed": round(at, 3)},
+                )
+                log.info("checkpoint: %s", path)
             if cfg.stop_at_target and time_to_target is not None:
                 break
     train_time = sum(epoch_train_s)
@@ -242,7 +310,7 @@ def run(cfg: Config) -> dict:
         "history": history,
         "final_test_err": history[-1]["test_err"] if history else None,
         "time_to_target": time_to_target,
-        "elapsed": time.perf_counter() - t0,
+        "elapsed": time.perf_counter() - t0 + prev_elapsed,
         "train_time": round(train_time, 3),
         "samples_trained": samples_trained,
         "samples_per_sec": round(sps, 1) if sps else None,

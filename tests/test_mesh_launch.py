@@ -54,6 +54,40 @@ def test_measure_throughput_reports_steady_rate():
     assert res["samples_per_sec_steady"] > 0
 
 
+def test_checkpoint_resume_matches_straight_run(tmp_path):
+    """2 epochs + resume for 2 more must reproduce the straight 4-epoch
+    run exactly: same data order (burned permutations), same losses."""
+    kw = dict(opt="easgd", su=2, mva=0.2, lr=0.1, mom=0.9)
+    straight = run(_tiny_cfg(epochs=4, **kw))
+    run(_tiny_cfg(epochs=2, ckpt_dir=str(tmp_path), **kw))
+    resumed = run(_tiny_cfg(epochs=4, resume="auto",
+                            ckpt_dir=str(tmp_path), **kw))
+    assert [h["epoch"] for h in resumed["history"]] == [2, 3]
+    for h_s, h_r in zip(straight["history"][2:], resumed["history"]):
+        np.testing.assert_allclose(h_r["avg_loss"], h_s["avg_loss"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h_r["test_err"], h_s["test_err"],
+                                   atol=1e-6)
+
+
+def test_resume_guards(tmp_path):
+    run(_tiny_cfg(opt="easgd", su=2, mva=0.2, epochs=1,
+                  ckpt_dir=str(tmp_path)))
+    with pytest.raises(ValueError, match="seed"):
+        run(_tiny_cfg(opt="easgd", su=2, mva=0.2, epochs=2, seed=99,
+                      resume="auto", ckpt_dir=str(tmp_path)))
+    with pytest.raises(ValueError, match="requires --ckpt_dir"):
+        run(_tiny_cfg(opt="easgd", su=2, mva=0.2, epochs=2, resume="auto"))
+
+
+def test_resume_shape_mismatch_fails_loudly(tmp_path):
+    run(_tiny_cfg(opt="easgd", su=2, mva=0.2, epochs=1,
+                  ckpt_dir=str(tmp_path)))
+    with pytest.raises(ValueError, match="keys|shape"):
+        run(_tiny_cfg(opt="syncdp", epochs=2, resume="auto",
+                      ckpt_dir=str(tmp_path)))
+
+
 def test_bad_opt_raises():
     with pytest.raises(ValueError, match="easgd|syncdp"):
         run(_tiny_cfg(opt="adamw"))

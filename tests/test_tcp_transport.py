@@ -188,6 +188,32 @@ class TestTcpTransport:
             a.close()
             b.close()
 
+    def test_graceful_close_keeps_old_silent_semantics(self):
+        """An orderly close() announces itself (goodbye frame): the
+        surviving side's probes/recvs must NOT raise connection-lost —
+        that convention is reserved for crashes.  This is the normal PS
+        teardown order (a client finishes and closes while the server
+        still serves)."""
+        a, b = make_mesh_transports(2)
+        try:
+            b.close()
+            deadline = time.monotonic() + 5
+            # The reader consumes the goodbye asynchronously; probes stay
+            # quietly False throughout and afterwards.
+            while time.monotonic() < deadline:
+                assert a.iprobe(1, 7) is False
+                if 1 not in a._peers or not any(
+                    t.is_alive() for t in a._threads
+                ):
+                    break
+                time.sleep(0.02)
+            assert a.iprobe(1, 7) is False
+            h = a.irecv(1, 7, out=np.empty(1, np.float32))
+            assert a.test(h) is False  # pending, not poisoned
+            a.cancel(h)
+        finally:
+            a.close()
+
     def test_close_cancels_queued_sends(self):
         """No orphaned handles: after close every send handle is done or
         cancelled (a blocking sender must not spin forever), and isend on
