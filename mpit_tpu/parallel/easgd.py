@@ -180,9 +180,14 @@ class MeshEASGD:
         self._steps = 0
         return state
 
+    @property
+    def batch_sharding(self):
+        return self._shardings["batch"]
+
     def shard_batch(self, *arrays: jnp.ndarray):
         """Place (n_dp, batch, ...) stacked arrays with the dp sharding.
-        Multi-process: pass only this process's worker rows."""
+        Multi-process: pass only this process's worker rows
+        (:func:`mpit_tpu.parallel.mesh.process_local_rows`)."""
         return tuple(put_local(a, self._shardings["batch"]) for a in arrays)
 
     # -- stepping ------------------------------------------------------------

@@ -112,3 +112,32 @@ def put_local(arr, sharding: NamedSharding):
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
     return jax.make_array_from_process_local_data(sharding, np.asarray(arr))
+
+
+def process_local_rows(sharding: NamedSharding, n_rows: int) -> slice:
+    """The contiguous block of leading-axis rows this process feeds to
+    :func:`put_local` for an array whose axis 0 is sharded by
+    ``sharding`` — i.e. the rows living on this process's addressable
+    devices.  Launchers that build a *global* batch on every host (same
+    seed, same shuffle) slice with this before ``shard_batch``.
+
+    Raises if the process's rows are not one contiguous block (cannot
+    happen with the row-major device layouts :func:`make_mesh` builds).
+    """
+    idx_map = sharding.addressable_devices_indices_map((n_rows,))
+    starts = sorted(
+        (0 if sl[0].start is None else sl[0].start,
+         n_rows if sl[0].stop is None else sl[0].stop)
+        for sl in idx_map.values()
+    )
+    lo = min(s for s, _ in starts)
+    hi = max(e for _, e in starts)
+    covered = sorted(set(starts))
+    span = 0
+    for s, e in covered:
+        if s > lo + span:
+            raise ValueError(
+                f"process rows not contiguous: {covered} over {n_rows}"
+            )
+        span = max(span, e - lo)
+    return slice(lo, hi)

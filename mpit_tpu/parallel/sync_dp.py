@@ -87,8 +87,13 @@ class SyncDataParallel:
             "k": jnp.zeros((), jnp.int32),
         }
 
+    @property
+    def batch_sharding(self):
+        return self._batch_sharding
+
     def shard_batch(self, *arrays: jnp.ndarray):
-        """Multi-process: pass only this process's batch rows."""
+        """Multi-process: pass only this process's batch rows
+        (:func:`mpit_tpu.parallel.mesh.process_local_rows`)."""
         return tuple(put_local(a, self._batch_sharding) for a in arrays)
 
     def step(self, state: Dict[str, Any], xb: jnp.ndarray, yb: jnp.ndarray):

@@ -133,51 +133,94 @@ def run(cfg: Config) -> dict:
         raise ValueError(f"opt must be easgd|syncdp, got {cfg.opt!r}")
     state = trainer.init(flat.w0.astype(dtype))
 
-    if (cfg.ckpt_dir or cfg.resume) and pg.num_processes > 1:
-        # Host-local numpy round-trips of globally-sharded state are
-        # invalid across processes, and every host would race the same
-        # _latest publish.  Fail at config time, not at first save.
-        raise ValueError(
-            "--ckpt_dir/--resume are single-process only for now "
-            "(multi-host checkpointing needs per-process shard IO)"
-        )
+    # Checkpoint backend: single-process uses the portable npz state
+    # dict; multi-process uses orbax, which writes each shard from the
+    # process holding it (host-local numpy round-trips of globally-
+    # sharded state are invalid, and the npz _latest publish would race
+    # across hosts).
+    use_orbax = pg.num_processes > 1
+
+    def _meta_path():
+        return pathlib.Path(cfg.ckpt_dir) / "mesh_meta.json"
+
     start_epoch = 0
     prev_elapsed = 0.0  # cumulative training seconds from resumed runs
     resume_path = cfg.resume
-    if resume_path == "auto":
-        if not cfg.ckpt_dir:
-            raise ValueError("--resume auto requires --ckpt_dir")
-        resume_path = str(pathlib.Path(cfg.ckpt_dir) / "mesh_latest.npz")
+    if resume_path == "auto" and not cfg.ckpt_dir:
+        raise ValueError("--resume auto requires --ckpt_dir")
     if resume_path:
-        from mpit_tpu.utils.checkpoint import load_state_dict
+        from mpit_tpu.utils.checkpoint import latest_pytree_step
 
-        saved, ck_meta = load_state_dict(resume_path)
-        if set(saved) != set(state):
-            raise ValueError(
-                f"checkpoint keys {sorted(saved)} do not match trainer "
-                f"state {sorted(state)} — wrong --opt or model?"
-            )
+        # Resume backend is detected from what is ON DISK, not from the
+        # current topology: a single process can restore orbax step dirs
+        # (load_pytree re-places to this run's shardings), while a
+        # multi-process group can never round-trip host-local npz.
+        disk_step = (latest_pytree_step(cfg.ckpt_dir)
+                     if cfg.ckpt_dir and resume_path == "auto" else None)
+        if disk_step is not None and not use_orbax:
+            # Mixed directory (multi-host steps + later single-process
+            # npz saves): prefer the newest artifact.
+            npz_latest = pathlib.Path(cfg.ckpt_dir) / "mesh_latest.npz"
+            step_dir = pathlib.Path(cfg.ckpt_dir) / f"step_{disk_step}"
+            if (npz_latest.exists()
+                    and npz_latest.stat().st_mtime > step_dir.stat().st_mtime):
+                disk_step = None
+        if resume_path == "auto" and disk_step is not None:
+            from mpit_tpu.utils.checkpoint import load_pytree
+
+            ck_meta = (json.loads(_meta_path().read_text())
+                       if _meta_path().exists() else {})
+            if ck_meta.get("opt", cfg.opt) != cfg.opt:
+                raise ValueError(
+                    f"checkpoint was trained with --opt {ck_meta['opt']}, "
+                    f"not {cfg.opt}"
+                )
+            state = load_pytree(cfg.ckpt_dir, disk_step, state)
+            # The step number, not the (separately written, possibly
+            # stale) meta file, defines where training resumes — a crash
+            # between the step write and the meta write must not cause
+            # silent double-training.
+            ck_meta["epoch"] = disk_step
+        else:
+            if use_orbax:
+                raise ValueError(
+                    "multi-process resume needs orbax step_* checkpoints "
+                    f"under --ckpt_dir (found none in {cfg.ckpt_dir!r}); "
+                    "host-local .npz checkpoints cannot restore a "
+                    "multi-process mesh"
+                )
+            from mpit_tpu.utils.checkpoint import load_state_dict
+
+            if resume_path == "auto":
+                resume_path = str(
+                    pathlib.Path(cfg.ckpt_dir) / "mesh_latest.npz")
+            saved, ck_meta = load_state_dict(resume_path)
+            if set(saved) != set(state):
+                raise ValueError(
+                    f"checkpoint keys {sorted(saved)} do not match trainer "
+                    f"state {sorted(state)} — wrong --opt or model?"
+                )
+            # Re-place each array with its mesh sharding (init produced
+            # the placement template; shapes must match exactly).
+            for key, arr in saved.items():
+                if tuple(arr.shape) != tuple(state[key].shape):
+                    raise ValueError(
+                        f"checkpoint {key} shape {arr.shape} != trainer "
+                        f"{tuple(state[key].shape)} (different mesh/model?)"
+                    )
+                state[key] = jax.device_put(
+                    jnp.asarray(arr), state[key].sharding
+                )
         if "seed" in ck_meta and int(ck_meta["seed"]) != int(cfg.seed):
             raise ValueError(
                 f"checkpoint was trained with --seed {ck_meta['seed']}, "
                 f"resuming with --seed {cfg.seed} would silently diverge "
                 "the data order — pass the original seed"
             )
-        # Re-place each array with its mesh sharding (init produced the
-        # placement template; shapes must match exactly).
-        for key, arr in saved.items():
-            if tuple(arr.shape) != tuple(state[key].shape):
-                raise ValueError(
-                    f"checkpoint {key} shape {arr.shape} != trainer "
-                    f"{tuple(state[key].shape)} (different mesh/model?)"
-                )
-            state[key] = jax.device_put(
-                jnp.asarray(arr), state[key].sharding
-            )
         start_epoch = int(ck_meta.get("epoch", -1)) + 1
         prev_elapsed = float(ck_meta.get("elapsed", 0.0))
-        log.info("resumed from %s at epoch %d (%.1fs of prior training)",
-                 resume_path, start_epoch, prev_elapsed)
+        log.info("resumed at epoch %d (%.1fs of prior training)",
+                 start_epoch, prev_elapsed)
 
     err_fn = jax.jit(
         lambda w, xb, yb: jnp.mean(
@@ -206,6 +249,17 @@ def run(cfg: Config) -> dict:
     epoch_train_s: List[float] = []  # step-loop only, per epoch
     samples_trained = 0
     t0 = time.perf_counter()
+    # Multi-process batch feeding: every process builds the same global
+    # shuffle (same seed) but hands shard_batch only the leading-axis
+    # rows its own devices hold (put_local's contract).
+    if pg.num_processes > 1:
+        from mpit_tpu.parallel.mesh import process_local_rows
+
+        lead = n_dp if cfg.opt == "easgd" else cfg.batch
+        rows = process_local_rows(trainer.batch_sharding, lead)
+    else:
+        rows = slice(None)
+
     # Resume reproducibility: burn the skipped epochs' permutations so
     # the data order continues exactly where the checkpointed run left it.
     for _ in range(start_epoch):
@@ -225,18 +279,20 @@ def run(cfg: Config) -> dict:
                          if cfg.opt == "easgd"
                          else (steps_per_epoch, cfg.batch))
                 x_ep = jnp.asarray(
-                    x_train[idx].reshape(*shape, -1), dtype)
-                y_ep = jnp.asarray(y_train[idx].reshape(shape))
+                    x_train[idx].reshape(*shape, -1)[:, rows], dtype)
+                y_ep = jnp.asarray(y_train[idx].reshape(shape)[:, rows])
             for step in range(steps_per_epoch):
                 if cfg.device_stream:
                     xb, yb = x_ep[step], y_ep[step]
                 else:
                     idx = order[step * per_step:(step + 1) * per_step]
-                    xb = jnp.asarray(x_train[idx], dtype)
-                    yb = jnp.asarray(y_train[idx])
+                    xb = np.asarray(x_train[idx], np.float32)
+                    yb = np.asarray(y_train[idx])
                     if cfg.opt == "easgd":
                         xb = xb.reshape(n_dp, cfg.batch, -1)
                         yb = yb.reshape(n_dp, cfg.batch)
+                    xb = jnp.asarray(xb[rows], dtype)
+                    yb = jnp.asarray(yb[rows])
                 state, loss = trainer.step(
                     state, *trainer.shard_batch(xb, yb)
                 )
@@ -258,15 +314,26 @@ def run(cfg: Config) -> dict:
             log.info("epoch %d avg_loss %.5f test_err %.4f (%.1fs)",
                      epoch, avg_loss, test_err, at)
             if cfg.ckpt_dir and (epoch + 1) % max(int(cfg.ckpt_every), 1) == 0:
-                from mpit_tpu.utils.checkpoint import save_state_dict
+                meta = {"epoch": epoch, "opt": cfg.opt,
+                        "test_err": test_err, "seed": cfg.seed,
+                        "elapsed": round(at, 3)}
+                if use_orbax:
+                    from mpit_tpu.utils.checkpoint import save_pytree
 
-                path = save_state_dict(
-                    cfg.ckpt_dir,
-                    {k: np.asarray(v) for k, v in state.items()},
-                    meta={"epoch": epoch, "opt": cfg.opt,
-                          "test_err": test_err, "seed": cfg.seed,
-                          "elapsed": round(at, 3)},
-                )
+                    save_pytree(cfg.ckpt_dir, state, step=epoch)
+                    if pg.process_id == 0:
+                        tmp = _meta_path().with_suffix(".tmp")
+                        tmp.write_text(json.dumps(meta))
+                        tmp.replace(_meta_path())
+                    path = f"{cfg.ckpt_dir}/step_{epoch}"
+                else:
+                    from mpit_tpu.utils.checkpoint import save_state_dict
+
+                    path = save_state_dict(
+                        cfg.ckpt_dir,
+                        {k: np.asarray(v) for k, v in state.items()},
+                        meta=meta,
+                    )
                 log.info("checkpoint: %s", path)
             if cfg.stop_at_target and time_to_target is not None:
                 break
@@ -292,8 +359,8 @@ def run(cfg: Config) -> dict:
         idx = rng.permutation(n)[: steps_per_epoch * per_step]
         shape = ((steps_per_epoch, n_dp, cfg.batch)
                  if cfg.opt == "easgd" else (steps_per_epoch, cfg.batch))
-        x_ep = jnp.asarray(x_train[idx].reshape(*shape, -1), dtype)
-        y_ep = jnp.asarray(y_train[idx].reshape(shape))
+        x_ep = jnp.asarray(x_train[idx].reshape(*shape, -1)[:, rows], dtype)
+        y_ep = jnp.asarray(y_train[idx].reshape(shape)[:, rows])
 
         def one_pass(st):
             for s in range(steps_per_epoch):

@@ -150,7 +150,10 @@ def load_server_state(
 
 
 def save_pytree(directory: str | pathlib.Path, pytree: Any, step: int) -> None:
-    """Full-pytree checkpoint via orbax (params + optimizer state)."""
+    """Full-pytree checkpoint via orbax (params + optimizer state).
+    Handles globally-sharded jax arrays — every process of a multi-host
+    mesh calls this collectively and orbax writes each shard from the
+    process that holds it."""
     import orbax.checkpoint as ocp
 
     path = pathlib.Path(directory).resolve() / f"step_{step}"
@@ -160,11 +163,24 @@ def save_pytree(directory: str | pathlib.Path, pytree: Any, step: int) -> None:
 
 
 def load_pytree(directory: str | pathlib.Path, step: int, like: Any) -> Any:
+    """Restore a :func:`save_pytree` checkpoint.  ``like`` supplies the
+    target structure/shardings (sharded jax arrays restore sharded)."""
     import orbax.checkpoint as ocp
 
     path = pathlib.Path(directory).resolve() / f"step_{step}"
     checkpointer = ocp.StandardCheckpointer()
     return checkpointer.restore(path, like)
+
+
+def latest_pytree_step(directory: str | pathlib.Path) -> Optional[int]:
+    """Highest ``step_N`` under an orbax checkpoint dir, or None."""
+    directory = pathlib.Path(directory)
+    steps = [
+        int(p.name.split("_", 1)[1])
+        for p in directory.glob("step_*")
+        if p.name.split("_", 1)[1].isdigit()
+    ]
+    return max(steps) if steps else None
 
 
 def save_state_dict(

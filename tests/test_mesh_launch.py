@@ -1,6 +1,12 @@
 """mesh_launch CLI on the 8-virtual-device mesh: both optimizers train
 (loss decreases, errors finite) and the result contract holds."""
 
+import json
+import os
+import socket
+import subprocess
+import sys
+
 import numpy as np
 import pytest
 
@@ -86,6 +92,55 @@ def test_resume_shape_mismatch_fails_loudly(tmp_path):
     with pytest.raises(ValueError, match="keys|shape"):
         run(_tiny_cfg(opt="syncdp", epochs=2, resume="auto",
                       ckpt_dir=str(tmp_path)))
+
+
+@pytest.mark.slow
+def test_two_process_distributed_train_ckpt_resume(tmp_path):
+    """Real multi-process jax.distributed end to end: two OS processes,
+    4 virtual CPU devices each, form one 8-device mesh, train EASGD with
+    per-process local batch rows, checkpoint via the orbax backend, and
+    resume.  This is the multi-host path the CLI advertises."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def launch(extra):
+        procs = []
+        for pid in (0, 1):
+            env = dict(
+                os.environ,
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                PYTHONPATH=os.path.dirname(os.path.dirname(__file__)),
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "mpit_tpu.train.mesh_launch",
+                 "--model", "linear", "--side", "8", "--batch", "32",
+                 "--opt", "easgd", "--su", "2", "--mva", "0.2",
+                 "--lr", "0.1", "--mom", "0.9",
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--num_processes", "2", "--process_id", str(pid),
+                 "--ckpt_dir", str(tmp_path)] + extra,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+                text=True,
+            ))
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"rank failed:\n{err[-3000:]}"
+            outs.append(out)
+        return outs
+
+    outs = launch(["--epochs", "2"])
+    res = json.loads(outs[0][outs[0].index("{"):])
+    assert res["processes"] == 2
+    assert res["mesh"]["dp"] * res["mesh"]["shard"] == 8
+    assert all(np.isfinite(h["test_err"]) for h in res["history"])
+    assert (tmp_path / "step_1").exists()  # orbax backend, not npz
+
+    outs = launch(["--epochs", "4", "--resume", "auto"])
+    res2 = json.loads(outs[0][outs[0].index("{"):])
+    assert [h["epoch"] for h in res2["history"]] == [2, 3]
 
 
 def test_bad_opt_raises():
