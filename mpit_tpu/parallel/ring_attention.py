@@ -421,12 +421,18 @@ def ring_attention(
     precision: str | None = None,
     layout: str = "contiguous",
     permute_inputs: bool = True,
+    batch_axis: str | None = None,
 ) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]:
     """Build the sequence-parallel attention fn over ``mesh[axis]``.
 
     Takes/returns global ``(B, L, H, D)`` arrays with L sharded over
     ``axis`` (L must divide evenly).  ``impl``: 'jnp', 'pallas', or
     'auto' (pallas on TPU, jnp elsewhere).  Callable from inside jit.
+
+    ``batch_axis`` additionally shards B over another mesh axis (the
+    dp x sp composition: independent rings run per data-parallel group;
+    without it, calling from a dp-sharded program would all-gather the
+    batch at the shard_map boundary).
 
     ``layout='zigzag'`` (causal only) balances causal work across the
     ring — each device owns an early and a late half-chunk, halving the
@@ -464,7 +470,7 @@ def ring_attention(
         qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
         return local(qh, kh, vh).transpose(0, 2, 1, 3)
 
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
     mapped = shard_map(
         _local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

@@ -1,0 +1,262 @@
+"""Long-context causal-LM training CLI — the sequence-parallel workload
+launcher.
+
+The reference's launchers drive conv/pool workloads (mlaunch/plaunch);
+this is the rebuild's beyond-parity long-context analog: TinyDecoder
+over a ``(dp, sp)`` device mesh — batch sharded over ``dp``, the
+sequence axis ring-sharded over ``sp``
+(:func:`mpit_tpu.parallel.ring_attention.ring_attention` with
+``batch_axis="dp"``), local pallas flash attention when ``sp == 1``.
+Parameters are replicated; gradients reduce across the mesh inside one
+jitted step; the update is the fused Nesterov sweep.
+
+Data is a byte corpus: ``--text_file`` (trained as raw bytes, vocab
+256) or a deterministic synthetic stream.  Example (8 virtual devices,
+2-way data x 4-way sequence parallel):
+
+    python -m mpit_tpu.train.lm_launch --dp 2 --sp 4 --seq_len 2048 \
+        --d_model 256 --n_layers 2 --steps 100
+
+Multi-host: same ``--hostfile`` / ``--coordinator`` surface as
+mesh_launch; each process feeds its own dp rows.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import pathlib
+import sys
+import time
+from typing import List, Optional
+
+from mpit_tpu.utils.config import Config
+from mpit_tpu.utils.logging import get_logger
+
+LM_LAUNCH_DEFAULTS = Config(
+    seq_len=1024,
+    d_model=256,
+    n_heads=8,
+    n_layers=2,
+    batch=8,  # global batch (rows sharded over dp)
+    steps=200,
+    lr=1e-3,
+    mom=0.9,
+    dp=0,  # 0 -> 1 (all devices on sp)
+    sp=0,  # 0 -> all remaining devices
+    layout="contiguous",  # contiguous | zigzag causal ring layout
+    attn_dtype="bfloat16",  # kernel input dtype: bfloat16 | float32
+    text_file="",
+    seed=1,
+    log_every=20,
+    ckpt_dir="",
+    ckpt_every=100,  # steps
+    resume="",  # "auto" -> <ckpt_dir>/lm_latest.npz
+    # multi-host bootstrap (parallel.distributed.bootstrap)
+    hostfile="",
+    coordinator="",
+    num_processes=0,
+    process_id=-1,
+)
+
+
+def _corpus(cfg: Config, log) -> "np.ndarray":
+    import numpy as np
+
+    if cfg.text_file:
+        data = np.frombuffer(
+            pathlib.Path(cfg.text_file).read_bytes(), np.uint8
+        ).astype(np.int32)
+        log.info("corpus: %s (%d bytes)", cfg.text_file, len(data))
+    else:
+        rng = np.random.default_rng(1234)
+        # Markov-ish synthetic bytes: learnable structure, not uniform noise.
+        n = max(1 << 20, 8 * (cfg.seq_len + 1) * cfg.batch)
+        trans = rng.integers(0, 256, (256, 4))
+        data = np.empty(n, np.int32)
+        data[0] = 0
+        choices = rng.integers(0, 4, n)
+        noise = rng.random(n)
+        for i in range(1, n):
+            data[i] = (trans[data[i - 1], choices[i]]
+                       if noise[i] > 0.1 else int(rng.integers(0, 256)))
+        log.info("corpus: synthetic markov bytes (%d)", n)
+    if len(data) < cfg.batch * (cfg.seq_len + 1):
+        raise ValueError(
+            f"corpus of {len(data)} tokens < one global batch "
+            f"({cfg.batch} x {cfg.seq_len + 1})"
+        )
+    return data
+
+
+def run(cfg: Config) -> dict:
+    from mpit_tpu.parallel.distributed import bootstrap
+
+    pg = bootstrap(
+        coordinator=cfg.coordinator or None,
+        num_processes=cfg.num_processes or None,
+        process_id=cfg.process_id if cfg.process_id >= 0 else None,
+        hostfile=cfg.hostfile or None,
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mpit_tpu.models import TinyDecoder, default_attn, flatten_module
+    from mpit_tpu.parallel.mesh import process_local_rows, put_local
+    from mpit_tpu.parallel.ring_attention import ring_attention
+    from mpit_tpu.utils.platform import default_devices
+
+    log = get_logger("lm", pg.process_id)
+    devs = default_devices()
+    dp = int(cfg.dp) or 1
+    sp = int(cfg.sp) or len(devs) // dp
+    if dp * sp != len(devs):
+        raise ValueError(f"dp*sp = {dp}*{sp} != {len(devs)} devices")
+    mesh = Mesh(np.asarray(devs).reshape(dp, sp), ("dp", "sp"))
+    log.info("mesh: dp=%d sp=%d", dp, sp)
+    if cfg.batch % dp:
+        raise ValueError(f"--batch {cfg.batch} not divisible by dp={dp}")
+    if cfg.seq_len % max(sp, 1):
+        raise ValueError(f"--seq_len {cfg.seq_len} not divisible by sp={sp}")
+
+    cast = jnp.bfloat16 if cfg.attn_dtype == "bfloat16" else None
+    inner = (ring_attention(mesh, "sp", causal=True, batch_axis="dp",
+                            layout=cfg.layout)
+             if sp > 1 else default_attn(causal=True))
+
+    def attn_fn(q, k, v):
+        out_dtype = q.dtype
+        if cast is not None:
+            q, k, v = (t.astype(cast) for t in (q, k, v))
+        return inner(q, k, v).astype(out_dtype)
+
+    model = TinyDecoder(
+        vocab=256, d_model=cfg.d_model, n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers, max_len=cfg.seq_len, attn_fn=attn_fn,
+    )
+    sample = jnp.zeros((max(cfg.batch // dp, 1), cfg.seq_len), jnp.int32)
+    flat = flatten_module(model, jax.random.PRNGKey(cfg.seed), sample)
+    log.info("flat params: %d", flat.size)
+
+    batch_sharding = NamedSharding(mesh, P("dp", None))
+
+    def loss_fn(w, toks):
+        logp = flat.apply_flat(w, toks[:, :-1])
+        tgt = toks[:, 1:]
+        return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], -1))
+
+    # Full Nesterov msgd (the framework's split lookahead/commit halves,
+    # optim/msgd.py — same math as the mesh trainers).
+    from mpit_tpu.optim.msgd import MSGDConfig, msgd_commit, msgd_lookahead
+
+    mcfg = MSGDConfig(lr=cfg.lr, mom=cfg.mom)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(w, vt, k, toks):
+        st = {"k": k, "vt": vt}
+        w_la, st = msgd_lookahead(w, st, mcfg)
+        loss, g = jax.value_and_grad(loss_fn)(w_la, toks)
+        w2, st2 = msgd_commit(w_la, g, st, mcfg)
+        return w2, st2["vt"], k + 1, loss
+
+    w, vt = flat.w0, jnp.zeros_like(flat.w0)
+    k_step = jnp.zeros((), jnp.int32)
+    start_step = 0
+    prev_elapsed = 0.0
+    resume_path = cfg.resume
+    if resume_path == "auto":
+        if not cfg.ckpt_dir:
+            raise ValueError("--resume auto requires --ckpt_dir")
+        resume_path = str(pathlib.Path(cfg.ckpt_dir) / "lm_latest.npz")
+    if resume_path:
+        from mpit_tpu.utils.checkpoint import load_state_dict
+
+        saved, meta = load_state_dict(resume_path)
+        if saved["w"].shape != tuple(flat.w0.shape):
+            raise ValueError(
+                f"checkpoint params {saved['w'].shape} != model "
+                f"{tuple(flat.w0.shape)} — different --d_model/--n_layers/"
+                "--seq_len?"
+            )
+        if "seed" in meta and int(meta["seed"]) != int(cfg.seed):
+            raise ValueError(
+                f"checkpoint was trained with --seed {meta['seed']}, "
+                f"resuming with --seed {cfg.seed} would silently diverge "
+                "the data stream — pass the original seed"
+            )
+        w = jnp.asarray(saved["w"])
+        vt = jnp.asarray(saved["vt"])
+        k_step = jnp.asarray(saved["k"])
+        start_step = int(meta.get("step", -1)) + 1
+        prev_elapsed = float(meta.get("elapsed", 0.0))
+        log.info("resumed at step %d", start_step)
+
+    data = _corpus(cfg, log)
+    rng = np.random.default_rng(cfg.seed)
+    # Burn the skipped steps' sampling so a resumed run continues the
+    # stream (one draw of cfg.batch starts per step).
+    for _ in range(start_step):
+        rng.integers(0, len(data) - cfg.seq_len - 1, cfg.batch)
+
+    rows = (process_local_rows(batch_sharding, cfg.batch)
+            if pg.num_processes > 1 else slice(None))
+
+    losses: List = []
+    history: List[dict] = []
+    t0 = time.perf_counter()
+    for step in range(start_step, cfg.steps):
+        starts = rng.integers(0, len(data) - cfg.seq_len - 1, cfg.batch)
+        toks = np.stack([data[s:s + cfg.seq_len + 1] for s in starts])
+        toks = put_local(jnp.asarray(toks[rows], jnp.int32), batch_sharding)
+        w, vt, k_step, loss = train_step(w, vt, k_step, toks)
+        losses.append(loss)
+        if (step + 1) % max(int(cfg.log_every), 1) == 0:
+            avg = float(jnp.mean(jnp.stack(losses)))
+            losses.clear()
+            log.info("step %d loss %.4f (%.1fs)", step, avg,
+                     time.perf_counter() - t0 + prev_elapsed)
+            history.append({"step": step, "avg_loss": avg})
+        if (cfg.ckpt_dir and pg.process_id == 0
+                and (step + 1) % max(int(cfg.ckpt_every), 1) == 0):
+            from mpit_tpu.utils.checkpoint import save_state_dict
+
+            save_state_dict(
+                cfg.ckpt_dir,
+                {"w": np.asarray(w), "vt": np.asarray(vt),
+                 "k": np.asarray(k_step)},
+                meta={"step": step, "seed": cfg.seed,
+                      "elapsed": round(time.perf_counter() - t0
+                                       + prev_elapsed, 3)},
+                prefix="lm",
+            )
+    elapsed = time.perf_counter() - t0 + prev_elapsed
+    if losses:
+        history.append({
+            "step": cfg.steps - 1,
+            "avg_loss": float(jnp.mean(jnp.stack(losses))),
+        })
+    trained = (cfg.steps - start_step) * cfg.batch * cfg.seq_len
+    return {
+        "history": history,
+        "final_loss": history[-1]["avg_loss"] if history else None,
+        "elapsed": round(elapsed, 3),
+        "tokens_trained": trained,
+        "tokens_per_sec": round(trained / max(elapsed - prev_elapsed, 1e-9), 1),
+        "mesh": {"dp": dp, "sp": sp},
+        "params": flat.size,
+        "processes": pg.num_processes,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    cfg = LM_LAUNCH_DEFAULTS.parse_args(
+        list(sys.argv[1:] if argv is None else argv)
+    )
+    print(json.dumps(run(cfg), indent=2))
+
+
+if __name__ == "__main__":
+    main()

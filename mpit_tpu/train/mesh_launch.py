@@ -80,11 +80,13 @@ def run(cfg: Config) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from mpit_tpu.data.mnist import load_mnist
     from mpit_tpu.models import MnistCNN, MnistLinear, MnistMLP, flatten_module
     from mpit_tpu.optim.msgd import MSGDConfig
     from mpit_tpu.parallel import MeshEASGD, SyncDataParallel, make_mesh
+    from mpit_tpu.parallel.mesh import put_local
 
     log = get_logger("mesh", pg.process_id)
     log.info("%s", pg.describe())
@@ -168,8 +170,14 @@ def run(cfg: Config) -> dict:
         if resume_path == "auto" and disk_step is not None:
             from mpit_tpu.utils.checkpoint import load_pytree
 
-            ck_meta = (json.loads(_meta_path().read_text())
-                       if _meta_path().exists() else {})
+            if not _meta_path().exists():
+                raise ValueError(
+                    f"step_{disk_step} exists but {_meta_path()} is "
+                    "missing — cannot validate opt/seed; the meta is "
+                    "written before every step, so this directory is "
+                    "corrupt or foreign"
+                )
+            ck_meta = json.loads(_meta_path().read_text())
             if ck_meta.get("opt", cfg.opt) != cfg.opt:
                 raise ValueError(
                     f"checkpoint was trained with --opt {ck_meta['opt']}, "
@@ -270,20 +278,32 @@ def run(cfg: Config) -> dict:
             losses = []
             t_ep = time.perf_counter()
             if cfg.device_stream:
-                # Stage the whole epoch in HBM with one transfer; per-step
-                # batches are device-side slices.  The shuffle is still
-                # fresh every epoch — this changes where the batches are
+                # Stage the whole epoch in HBM with one placement (each
+                # process contributes its local rows; the staged arrays
+                # carry the step axis in front of the batch sharding, so
+                # per-step slices are already correctly sharded and skip
+                # shard_batch entirely).  The shuffle is still fresh
+                # every epoch — this changes where the batches are
                 # assembled, not what is trained.
                 idx = order[: steps_per_epoch * per_step]
                 shape = ((steps_per_epoch, n_dp, cfg.batch)
                          if cfg.opt == "easgd"
                          else (steps_per_epoch, cfg.batch))
-                x_ep = jnp.asarray(
-                    x_train[idx].reshape(*shape, -1)[:, rows], dtype)
-                y_ep = jnp.asarray(y_train[idx].reshape(shape)[:, rows])
+                ep_sharding = NamedSharding(
+                    mesh, P(None, *trainer.batch_sharding.spec)
+                )
+                x_ep = put_local(
+                    x_train[idx].reshape(*shape, -1)[:, rows].astype(dtype),
+                    ep_sharding)
+                y_ep = put_local(
+                    y_train[idx].reshape(shape)[:, rows], ep_sharding)
             for step in range(steps_per_epoch):
                 if cfg.device_stream:
-                    xb, yb = x_ep[step], y_ep[step]
+                    state, loss = trainer.step(
+                        state, x_ep[step], y_ep[step]
+                    )
+                    losses.append(loss)
+                    continue
                 else:
                     idx = order[step * per_step:(step + 1) * per_step]
                     xb = np.asarray(x_train[idx], np.float32)
@@ -320,11 +340,15 @@ def run(cfg: Config) -> dict:
                 if use_orbax:
                     from mpit_tpu.utils.checkpoint import save_pytree
 
-                    save_pytree(cfg.ckpt_dir, state, step=epoch)
+                    # Meta BEFORE the step dir: the resume epoch comes
+                    # from the step number, so a crash in between leaves
+                    # a slightly-ahead meta (harmless) rather than a
+                    # step with no seed guard.
                     if pg.process_id == 0:
                         tmp = _meta_path().with_suffix(".tmp")
                         tmp.write_text(json.dumps(meta))
                         tmp.replace(_meta_path())
+                    save_pytree(cfg.ckpt_dir, state, step=epoch)
                     path = f"{cfg.ckpt_dir}/step_{epoch}"
                 else:
                     from mpit_tpu.utils.checkpoint import save_state_dict
@@ -359,14 +383,17 @@ def run(cfg: Config) -> dict:
         idx = rng.permutation(n)[: steps_per_epoch * per_step]
         shape = ((steps_per_epoch, n_dp, cfg.batch)
                  if cfg.opt == "easgd" else (steps_per_epoch, cfg.batch))
-        x_ep = jnp.asarray(x_train[idx].reshape(*shape, -1)[:, rows], dtype)
-        y_ep = jnp.asarray(y_train[idx].reshape(shape)[:, rows])
+        ep_sharding = NamedSharding(
+            mesh, P(None, *trainer.batch_sharding.spec)
+        )
+        x_ep = put_local(
+            x_train[idx].reshape(*shape, -1)[:, rows].astype(dtype),
+            ep_sharding)
+        y_ep = put_local(y_train[idx].reshape(shape)[:, rows], ep_sharding)
 
         def one_pass(st):
             for s in range(steps_per_epoch):
-                st, _loss = trainer.step(
-                    st, *trainer.shard_batch(x_ep[s], y_ep[s])
-                )
+                st, _loss = trainer.step(st, x_ep[s], y_ep[s])
             return st
 
         per_pass = timed_chained(
