@@ -60,6 +60,9 @@ LM_LAUNCH_DEFAULTS = Config(
 )
 
 
+_SYNTH_CACHE: dict = {}
+
+
 def _corpus(cfg: Config, log) -> "np.ndarray":
     import numpy as np
 
@@ -69,17 +72,23 @@ def _corpus(cfg: Config, log) -> "np.ndarray":
         ).astype(np.int32)
         log.info("corpus: %s (%d bytes)", cfg.text_file, len(data))
     else:
-        rng = np.random.default_rng(1234)
-        # Markov-ish synthetic bytes: learnable structure, not uniform noise.
+        # Markov-ish synthetic bytes: learnable structure, not uniform
+        # noise.  Deterministic in n — memoized, the scalar chain costs
+        # ~1.5s/MB and every run() call would otherwise regenerate it.
         n = max(1 << 20, 8 * (cfg.seq_len + 1) * cfg.batch)
-        trans = rng.integers(0, 256, (256, 4))
-        data = np.empty(n, np.int32)
-        data[0] = 0
-        choices = rng.integers(0, 4, n)
-        noise = rng.random(n)
-        for i in range(1, n):
-            data[i] = (trans[data[i - 1], choices[i]]
-                       if noise[i] > 0.1 else int(rng.integers(0, 256)))
+        data = _SYNTH_CACHE.get(n)
+        if data is None:
+            rng = np.random.default_rng(1234)
+            trans = rng.integers(0, 256, (256, 4))
+            data = np.empty(n, np.int32)
+            data[0] = 0
+            choices = rng.integers(0, 4, n)
+            noise = rng.random(n)
+            resets = rng.integers(0, 256, n)
+            for i in range(1, n):
+                data[i] = (trans[data[i - 1], choices[i]]
+                           if noise[i] > 0.1 else resets[i])
+            _SYNTH_CACHE[n] = data
         log.info("corpus: synthetic markov bytes (%d)", n)
     if len(data) < cfg.batch * (cfg.seq_len + 1):
         raise ValueError(
@@ -105,7 +114,9 @@ def run(cfg: Config) -> dict:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from mpit_tpu.models import TinyDecoder, default_attn, flatten_module
-    from mpit_tpu.parallel.mesh import process_local_rows, put_local
+    from mpit_tpu.parallel.mesh import (
+        process_local_rows, put_global, put_local,
+    )
     from mpit_tpu.parallel.ring_attention import ring_attention
     from mpit_tpu.utils.platform import default_devices
 
@@ -162,8 +173,13 @@ def run(cfg: Config) -> dict:
         w2, st2 = msgd_commit(w_la, g, st, mcfg)
         return w2, st2["vt"], k + 1, loss
 
-    w, vt = flat.w0, jnp.zeros_like(flat.w0)
-    k_step = jnp.zeros((), jnp.int32)
+    # Replicated placement over the global mesh: a multi-host program
+    # cannot place host-local arrays on non-addressable devices
+    # (put_global docstring, parallel/mesh.py).
+    rep = NamedSharding(mesh, P())
+    w = put_global(flat.w0, rep)
+    vt = put_global(jnp.zeros_like(flat.w0), rep)
+    k_step = put_global(jnp.zeros((), jnp.int32), rep)
     start_step = 0
     prev_elapsed = 0.0
     resume_path = cfg.resume
@@ -181,15 +197,23 @@ def run(cfg: Config) -> dict:
                 f"{tuple(flat.w0.shape)} — different --d_model/--n_layers/"
                 "--seq_len?"
             )
+        want = {"d_model": cfg.d_model, "n_heads": cfg.n_heads,
+                "n_layers": cfg.n_layers, "seq_len": cfg.seq_len}
+        if "model" in meta and meta["model"] != want:
+            raise ValueError(
+                f"checkpoint model config {meta['model']} != {want} — "
+                "same flat size does not make the same model (n_heads "
+                "changes the attention head split silently)"
+            )
         if "seed" in meta and int(meta["seed"]) != int(cfg.seed):
             raise ValueError(
                 f"checkpoint was trained with --seed {meta['seed']}, "
                 f"resuming with --seed {cfg.seed} would silently diverge "
                 "the data stream — pass the original seed"
             )
-        w = jnp.asarray(saved["w"])
-        vt = jnp.asarray(saved["vt"])
-        k_step = jnp.asarray(saved["k"])
+        w = put_global(jnp.asarray(saved["w"]), rep)
+        vt = put_global(jnp.asarray(saved["vt"]), rep)
+        k_step = put_global(jnp.asarray(saved["k"]), rep)
         start_step = int(meta.get("step", -1)) + 1
         prev_elapsed = float(meta.get("elapsed", 0.0))
         log.info("resumed at step %d", start_step)
@@ -228,6 +252,10 @@ def run(cfg: Config) -> dict:
                 {"w": np.asarray(w), "vt": np.asarray(vt),
                  "k": np.asarray(k_step)},
                 meta={"step": step, "seed": cfg.seed,
+                      "model": {"d_model": cfg.d_model,
+                                "n_heads": cfg.n_heads,
+                                "n_layers": cfg.n_layers,
+                                "seq_len": cfg.seq_len},
                       "elapsed": round(time.perf_counter() - t0
                                        + prev_elapsed, 3)},
                 prefix="lm",

@@ -268,6 +268,23 @@ def run(cfg: Config) -> dict:
     else:
         rows = slice(None)
 
+    def stage_epoch(idx):
+        """One HBM placement of a shuffled epoch, step axis in front of
+        the batch sharding — per-step slices are already correctly
+        sharded and feed the trainer directly (each process contributes
+        only its local rows)."""
+        shape = ((steps_per_epoch, n_dp, cfg.batch)
+                 if cfg.opt == "easgd" else (steps_per_epoch, cfg.batch))
+        ep_sharding = NamedSharding(
+            mesh, P(None, *trainer.batch_sharding.spec)
+        )
+        x_ep = put_local(
+            x_train[idx].reshape(*shape, -1)[:, rows].astype(dtype),
+            ep_sharding)
+        y_ep = put_local(
+            y_train[idx].reshape(shape)[:, rows], ep_sharding)
+        return x_ep, y_ep
+
     # Resume reproducibility: burn the skipped epochs' permutations so
     # the data order continues exactly where the checkpointed run left it.
     for _ in range(start_epoch):
@@ -278,45 +295,25 @@ def run(cfg: Config) -> dict:
             losses = []
             t_ep = time.perf_counter()
             if cfg.device_stream:
-                # Stage the whole epoch in HBM with one placement (each
-                # process contributes its local rows; the staged arrays
-                # carry the step axis in front of the batch sharding, so
-                # per-step slices are already correctly sharded and skip
-                # shard_batch entirely).  The shuffle is still fresh
-                # every epoch — this changes where the batches are
-                # assembled, not what is trained.
-                idx = order[: steps_per_epoch * per_step]
-                shape = ((steps_per_epoch, n_dp, cfg.batch)
-                         if cfg.opt == "easgd"
-                         else (steps_per_epoch, cfg.batch))
-                ep_sharding = NamedSharding(
-                    mesh, P(None, *trainer.batch_sharding.spec)
-                )
-                x_ep = put_local(
-                    x_train[idx].reshape(*shape, -1)[:, rows].astype(dtype),
-                    ep_sharding)
-                y_ep = put_local(
-                    y_train[idx].reshape(shape)[:, rows], ep_sharding)
-            for step in range(steps_per_epoch):
-                if cfg.device_stream:
-                    state, loss = trainer.step(
-                        state, x_ep[step], y_ep[step]
-                    )
+                # The shuffle is still fresh every epoch — staging
+                # changes where batches are assembled, not what is
+                # trained (regression-tested against the host path).
+                x_ep, y_ep = stage_epoch(order[: steps_per_epoch * per_step])
+                for step in range(steps_per_epoch):
+                    state, loss = trainer.step(state, x_ep[step], y_ep[step])
                     losses.append(loss)
-                    continue
-                else:
+            else:
+                for step in range(steps_per_epoch):
                     idx = order[step * per_step:(step + 1) * per_step]
                     xb = np.asarray(x_train[idx], np.float32)
                     yb = np.asarray(y_train[idx])
                     if cfg.opt == "easgd":
                         xb = xb.reshape(n_dp, cfg.batch, -1)
                         yb = yb.reshape(n_dp, cfg.batch)
-                    xb = jnp.asarray(xb[rows], dtype)
-                    yb = jnp.asarray(yb[rows])
-                state, loss = trainer.step(
-                    state, *trainer.shard_batch(xb, yb)
-                )
-                losses.append(loss)
+                    state, loss = trainer.step(state, *trainer.shard_batch(
+                        jnp.asarray(xb[rows], dtype), jnp.asarray(yb[rows])
+                    ))
+                    losses.append(loss)
             avg_loss = float(jnp.mean(jnp.stack(losses)))
             epoch_train_s.append(time.perf_counter() - t_ep)
             samples_trained += steps_per_epoch * per_step
@@ -380,16 +377,8 @@ def run(cfg: Config) -> dict:
         # training programs.
         from mpit_tpu.utils.timing import timed_chained
 
-        idx = rng.permutation(n)[: steps_per_epoch * per_step]
-        shape = ((steps_per_epoch, n_dp, cfg.batch)
-                 if cfg.opt == "easgd" else (steps_per_epoch, cfg.batch))
-        ep_sharding = NamedSharding(
-            mesh, P(None, *trainer.batch_sharding.spec)
-        )
-        x_ep = put_local(
-            x_train[idx].reshape(*shape, -1)[:, rows].astype(dtype),
-            ep_sharding)
-        y_ep = put_local(y_train[idx].reshape(shape)[:, rows], ep_sharding)
+        x_ep, y_ep = stage_epoch(
+            rng.permutation(n)[: steps_per_epoch * per_step])
 
         def one_pass(st):
             for s in range(steps_per_epoch):

@@ -30,10 +30,14 @@ def test_mesh_factorizations_agree():
     training trajectory however the mesh is factored — the ring is exact
     attention and the loss is a global-batch mean."""
     results = {
-        (dp, sp): run(_cfg(steps=10, lr=1e-3, dp=dp, sp=sp, log_every=1))
-        for dp, sp in [(8, 1), (2, 4), (1, 8)]
+        (dp, sp, layout): run(_cfg(steps=10, lr=1e-3, dp=dp, sp=sp,
+                                   layout=layout, log_every=1))
+        for dp, sp, layout in [
+            (8, 1, "contiguous"), (2, 4, "contiguous"), (1, 8, "contiguous"),
+            (2, 4, "zigzag"),  # balanced layout is exact attention too
+        ]
     }
-    base = [h["avg_loss"] for h in results[(8, 1)]["history"]]
+    base = [h["avg_loss"] for h in results[(8, 1, "contiguous")]["history"]]
     for key, res in results.items():
         losses = [h["avg_loss"] for h in res["history"]]
         np.testing.assert_allclose(losses, base, rtol=2e-4, atol=2e-5,
