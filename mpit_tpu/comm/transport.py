@@ -70,7 +70,17 @@ class Transport(abc.ABC):
 
     @abc.abstractmethod
     def iprobe(self, src: int, tag: int) -> bool:
-        """True when a fully-assembled matching message is available."""
+        """True when a fully-assembled matching message is available.
+
+        Fail-loud contract: when the transport *knows* ``src`` can never
+        deliver again (dead peer, torn connection) and no matching message
+        is buffered, implementations should raise ``RuntimeError`` rather
+        than return ``False`` — the schedulers' probe-then-recv loops
+        (aio/scheduler.py) would otherwise poll a drained channel forever.
+        TcpTransport implements this; ShmTransport relies on its
+        EOWNERDEAD remap to resurrect the peer instead, so a probe there
+        keeps returning ``False`` while recovery is in progress.
+        """
 
     @abc.abstractmethod
     def test(self, handle: Handle) -> bool:

@@ -63,6 +63,13 @@ LM_LAUNCH_DEFAULTS = Config(
 _SYNTH_CACHE: dict = {}
 
 
+def _corpus_key(text_file: str) -> str:
+    """Identity of the training corpus for resume guards: the resolved
+    path ("" for the synthetic stream).  Stored resolved at save time so
+    the comparison is cwd-independent."""
+    return str(pathlib.Path(text_file).resolve()) if text_file else ""
+
+
 def _corpus(cfg: Config, log) -> "np.ndarray":
     import numpy as np
 
@@ -148,7 +155,13 @@ def run(cfg: Config) -> dict:
         vocab=256, d_model=cfg.d_model, n_heads=cfg.n_heads,
         n_layers=cfg.n_layers, max_len=cfg.seq_len, attn_fn=attn_fn,
     )
-    sample = jnp.zeros((max(cfg.batch // dp, 1), cfg.seq_len), jnp.int32)
+    # ring_attention(batch_axis="dp") shard_maps the init sample's batch
+    # axis over dp, so the sample must be dp-divisible exactly like a
+    # training batch — a (batch//dp)-row sample would shard over dp
+    # *again* and crash for valid configs (e.g. dp=4 sp=2 batch=8:
+    # 2 rows % 4 != 0).  dp rows is the smallest valid sample; param
+    # shapes don't depend on batch.
+    sample = jnp.zeros((dp, cfg.seq_len), jnp.int32)
     flat = flatten_module(model, jax.random.PRNGKey(cfg.seed), sample)
     log.info("flat params: %d", flat.size)
 
@@ -211,6 +224,24 @@ def run(cfg: Config) -> dict:
                 f"resuming with --seed {cfg.seed} would silently diverge "
                 "the data stream — pass the original seed"
             )
+        # The skipped-step burn draws cfg.batch starts per step and the
+        # synthetic corpus size depends on batch: a different --batch (or
+        # corpus) silently diverges the stream exactly like a seed change.
+        if "batch" in meta and int(meta["batch"]) != int(cfg.batch):
+            raise ValueError(
+                f"checkpoint was trained with --batch {meta['batch']}, "
+                f"resuming with --batch {cfg.batch} would silently diverge "
+                "the data stream — pass the original batch"
+            )
+        # meta stores the save-time *resolved* path; resolving the saved
+        # string here against the resume-time cwd would compare the wrong
+        # file whenever the cwds differ.
+        if ("text_file" in meta
+                and meta["text_file"] != _corpus_key(cfg.text_file)):
+            raise ValueError(
+                f"checkpoint was trained on {meta['text_file']!r}, "
+                f"resuming on {cfg.text_file!r} is a different corpus"
+            )
         w = put_global(jnp.asarray(saved["w"]), rep)
         vt = put_global(jnp.asarray(saved["vt"]), rep)
         k_step = put_global(jnp.asarray(saved["k"]), rep)
@@ -252,6 +283,8 @@ def run(cfg: Config) -> dict:
                 {"w": np.asarray(w), "vt": np.asarray(vt),
                  "k": np.asarray(k_step)},
                 meta={"step": step, "seed": cfg.seed,
+                      "batch": cfg.batch,
+                      "text_file": _corpus_key(cfg.text_file),
                       "model": {"d_model": cfg.d_model,
                                 "n_heads": cfg.n_heads,
                                 "n_layers": cfg.n_layers,

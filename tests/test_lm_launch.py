@@ -57,6 +57,23 @@ def test_checkpoint_resume_continues_stream(tmp_path):
         straight["history"][-1]["avg_loss"], rtol=1e-5)
 
 
+def test_init_with_dp_not_dividing_local_rows():
+    """dp=4 sp=2 batch=8: batch//dp = 2 rows is NOT divisible by dp.
+    The init sample's row count must be dp-divisible (it is shard_mapped
+    over dp like a training batch); a (batch//dp)-row sample would crash
+    at flatten_module for this valid config."""
+    res = run(_cfg(steps=4, lr=1e-3, dp=4, sp=2, log_every=2))
+    assert np.isfinite(res["history"][-1]["avg_loss"])
+
+
+def test_resume_batch_mismatch_raises(tmp_path):
+    run(_cfg(steps=4, lr=1e-3, dp=2, sp=4, log_every=2,
+             ckpt_dir=str(tmp_path), ckpt_every=2))
+    with pytest.raises(ValueError, match="batch"):
+        run(_cfg(steps=8, lr=1e-3, dp=2, sp=4, batch=16, log_every=2,
+                 ckpt_dir=str(tmp_path), resume="auto"))
+
+
 def test_bad_factorization_raises():
     with pytest.raises(ValueError, match="devices"):
         run(_cfg(steps=1, dp=3, sp=2))
