@@ -39,12 +39,21 @@ def fetch_scalar(out: Any) -> float:
     return float(np.asarray(leaf[(0,) * getattr(leaf, "ndim", 0)]))
 
 
+# Smallest per-call time the estimator will ever report.  A differenced
+# estimate at or below zero means the extra iterations were lost in
+# timer/scheduler noise; reporting a strictly-positive floor keeps
+# machine-read JSON out of the nonsensical "0.0 ms" / negative regime.
+MIN_RESOLVABLE_S = 1e-9
+
+
 def timed_per_call(
     fn: Callable[..., Any],
     *args: Any,
     iters: int = 10,
     base_iters: int = 1,
     repeats: int = 3,
+    auto_scale: bool = False,
+    max_iters: int = 2000,
 ) -> float:
     """Seconds per call of ``fn(*args)`` on device, latency-cancelled.
 
@@ -55,6 +64,13 @@ def timed_per_call(
     additive-positive, so min() per leg filters it, whereas min over
     *differences* would lock in exactly the repeat whose short leg
     caught a spike (an overestimate of speed).
+
+    With ``auto_scale``, when the big-leg/small-leg difference does not
+    exceed the observed per-leg jitter (sub-resolution: the measured op
+    is too fast for ``iters`` at the current load), ``iters`` doubles and
+    the measurement reruns, up to ``max_iters`` — fast ops on a loaded
+    host otherwise difference two minima into a ≤0 estimate.  The result
+    is always floored at :data:`MIN_RESOLVABLE_S`.
     """
     fetch_scalar(fn(*args))  # compile + warm
 
@@ -66,9 +82,18 @@ def timed_per_call(
         fetch_scalar(out)
         return time.perf_counter() - t0
 
-    t_small = min(run(base_iters) for _ in range(repeats))
-    t_big = min(run(base_iters + iters) for _ in range(repeats))
-    return max(t_big - t_small, 1e-12) / iters
+    while True:
+        # the small leg is deliberately re-measured every escalation
+        # round: its minimum and spread anchor the jitter estimate, and
+        # host load drifts over the seconds an escalated measurement
+        # takes — stale smalls would difference against old conditions.
+        smalls = [run(base_iters) for _ in range(repeats)]
+        bigs = [run(base_iters + iters) for _ in range(repeats)]
+        delta = min(bigs) - min(smalls)
+        jitter = max(max(smalls) - min(smalls), max(bigs) - min(bigs))
+        if (not auto_scale or delta > jitter or iters * 2 > max_iters):
+            return max(delta, MIN_RESOLVABLE_S * iters) / iters
+        iters *= 2
 
 
 def timed_chained(
@@ -101,4 +126,4 @@ def timed_chained(
         smalls.append(t_small)
         t_big, state = run(base_iters + iters, state)
         bigs.append(t_big)
-    return max(min(bigs) - min(smalls), 1e-12) / iters
+    return max(min(bigs) - min(smalls), MIN_RESOLVABLE_S * iters) / iters
