@@ -26,8 +26,19 @@ epoch, per-step slices) — the reference ran its ranks on CPU torch
 CPU-torch throughput of the identical workload is the honest stand-in.
 >1.0 means this framework beats the reference-shaped run.
 
+Reproducibility (round-4 discipline): every leg runs
+``MPIT_BENCH_REPS`` times (default 3) and the JSON carries the median
+plus the per-run values and max-min spread — the tunnel jitter is
+documented at ±20% (docs/KERNEL_BENCH.md), so a single-shot number is
+not evidence.  jit compile is excluded from the timed region (the
+trainers precompile with the persistent XLA cache,
+utils/platform.enable_compile_cache) and reported separately as
+``compile_s``; ``time_to_target_s`` is wall clock from t0 *after*
+warmup, as a warm-cache user would experience it.
+
 Env knobs: MPIT_BENCH_EPOCHS (default 30), MPIT_BENCH_MB (PS payload,
-default 64), MPIT_BENCH_ROUNDS (default 20).
+default 640 — the reference ptest.lua:3 scale), MPIT_BENCH_ROUNDS
+(default 20), MPIT_BENCH_REPS (default 3).
 """
 
 from __future__ import annotations
@@ -46,13 +57,34 @@ os.environ.setdefault("MPIT_LOG_STREAM", "stderr")
 BATCH = 128
 SIDE = 32
 EPOCHS = int(os.environ.get("MPIT_BENCH_EPOCHS", "30"))
-PS_MB = float(os.environ.get("MPIT_BENCH_MB", "64"))
+PS_MB = float(os.environ.get("MPIT_BENCH_MB", "640"))  # ptest.lua:3 payload
 PS_ROUNDS = int(os.environ.get("MPIT_BENCH_ROUNDS", "20"))
+REPS = max(int(os.environ.get("MPIT_BENCH_REPS", "3")), 1)
 TORCH_ITERS = 30
 
 
 def _log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def _median(xs):
+    return float(np.median(np.asarray(xs, np.float64)))
+
+
+def _spread_pct(xs):
+    """max-min spread as % of the median (0 for degenerate medians)."""
+    med = _median(xs)
+    return abs(max(xs) - min(xs)) / abs(med) * 100.0 if med else 0.0
+
+
+def _torch_threads() -> int:
+    """Cores actually usable by this process (affinity/cgroup aware) —
+    os.cpu_count() would oversubscribe a pinned container and slow the
+    torch baseline below its honest rate."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:  # non-linux
+        return os.cpu_count() or 1
 
 
 def bench_train() -> dict:
@@ -68,7 +100,7 @@ def bench_train() -> dict:
     cfg = MESH_LAUNCH_DEFAULTS.merged(
         opt="easgd", model="cnn", epochs=EPOCHS, batch=BATCH, side=SIDE,
         su=10, mom=0.99, lr=1e-2, target_test_err=target, stop_at_target=1,
-        device_stream=1, measure_throughput=1,
+        device_stream=1, measure_throughput=1, precompile=1,
     )
     result = run(cfg)
     result["target_test_err"] = target
@@ -99,7 +131,9 @@ def bench_ps_pushpull() -> dict:
 def bench_torch_cpu() -> float:
     """Reference-equivalent: identical CNN + Nesterov SGD, torch on CPU,
     same staged-epoch pipeline as the jax leg (one permuted tensor per
-    epoch, per-step slices of fresh data)."""
+    epoch, per-step slices of fresh data).  Threads pinned to the host's
+    core count (deterministic per host — the round-3 725->1157 samples/s
+    drift came from an unpinned, load-dependent thread pool)."""
     import torch
     import torch.nn as tnn
 
@@ -107,7 +141,7 @@ def bench_torch_cpu() -> float:
 
     (x_train, y_train, _, _), _src = load_mnist(side=SIDE)
     torch.manual_seed(0)
-    torch.set_num_threads(max(torch.get_num_threads(), 1))
+    torch.set_num_threads(_torch_threads())
     width = 32
     model = tnn.Sequential(
         tnn.Conv2d(1, width, 3, padding=1), tnn.ReLU(), tnn.MaxPool2d(2),
@@ -146,33 +180,61 @@ def bench_torch_cpu() -> float:
 
 
 def main():
-    train = bench_train()
-    sps = train["samples_per_sec_steady"] or train["samples_per_sec"] or 0.0
-    try:
-        ps = bench_ps_pushpull()
-    except Exception as e:
-        _log(f"ps bandwidth leg failed: {e!r}")
-        ps = {"per_chip": None, "devices": 0}
-    try:
-        base = bench_torch_cpu()
-        vs = sps / base if base > 0 else 0.0
-    except Exception as e:  # torch missing/broken: report raw throughput
-        _log(f"torch baseline failed: {e!r}")
-        vs = 0.0
+    trains = []
+    for rep in range(REPS):
+        _log(f"-- train rep {rep + 1}/{REPS} --")
+        trains.append(bench_train())
+    sps_runs = [
+        t["samples_per_sec_steady"] or t["samples_per_sec"] or 0.0
+        for t in trains
+    ]
+    ttt_runs = [t["time_to_target"] for t in trains
+                if t["time_to_target"] is not None]
+    compile_runs = [t["compile_s"] for t in trains
+                    if t["compile_s"] is not None]
+    sps = _median(sps_runs)
+    train = trains[0]  # target/data_source/final_err are rep-invariant
+
+    ps_runs = []
+    for rep in range(REPS):
+        try:
+            ps_runs.append(bench_ps_pushpull())
+        except Exception as e:
+            _log(f"ps bandwidth rep {rep + 1} failed: {e!r}")
+    ps_chip = [r["per_chip"] for r in ps_runs if r.get("per_chip")]
+
+    torch_runs = []
+    for rep in range(REPS):
+        try:
+            torch_runs.append(bench_torch_cpu())
+        except Exception as e:  # torch missing/broken: report raw throughput
+            _log(f"torch baseline rep {rep + 1} failed: {e!r}")
+    base = _median(torch_runs) if torch_runs else 0.0
+    vs = sps / base if base > 0 else 0.0
+
     print(json.dumps({
         "metric": "mnist_easgd_train_samples_per_sec",
         "value": round(sps, 1),
         "unit": "samples/s",
         "vs_baseline": round(vs, 3),
-        "time_to_target_s": round(train["time_to_target"], 3)
-        if train["time_to_target"] is not None else None,
+        "reps": REPS,
+        "value_runs": [round(v, 1) for v in sps_runs],
+        "value_spread_pct": round(_spread_pct(sps_runs), 1),
+        "time_to_target_s": round(_median(ttt_runs), 3) if ttt_runs else None,
+        "time_to_target_runs": [round(v, 3) for v in ttt_runs],
+        "compile_s": round(_median(compile_runs), 3) if compile_runs else None,
         "target_test_err": train["target_test_err"],
         "final_test_err": train["final_test_err"],
         "epochs_run": len(train["history"]),
         "data_source": train["data_source"],
-        "ps_pushpull_mbs_per_chip": round(ps["per_chip"], 1)
-        if ps["per_chip"] else None,
-        "ps_devices": ps["devices"],
+        "ps_pushpull_mbs_per_chip": round(_median(ps_chip), 1)
+        if ps_chip else None,
+        "ps_pushpull_runs": [round(v, 1) for v in ps_chip],
+        "ps_spread_pct": round(_spread_pct(ps_chip), 1) if ps_chip else None,
+        "ps_devices": ps_runs[0]["devices"] if ps_runs else 0,
+        "torch_cpu_sps": round(base, 1) if torch_runs else None,
+        "torch_cpu_runs": [round(v, 1) for v in torch_runs],
+        "torch_threads": _torch_threads(),
     }))
 
 

@@ -155,6 +155,52 @@ class MeshEASGD:
             donate_argnums=(0, 1, 2, 3),
         )
 
+        # Whole-epoch program: lax.scan over a staged (nsteps, ...) epoch
+        # with the elastic exchange as a lax.cond on the device-resident
+        # step counter.  ONE dispatch trains a whole epoch — on tunneled
+        # platforms the per-call dispatch round-trip (~ms) otherwise
+        # bounds small-model throughput, not the TPU (measured: the
+        # step-loop path swung 17k-34k samples/s with tunnel load while
+        # the scan path holds the device-limited rate).
+        def _epoch(w, vt, k, center, xs, ys):
+            def body(carry, xy):
+                w, vt, k, center = carry
+                xb, yb = xy
+
+                def _sync(ops):
+                    w, vt, k, center = ops
+                    w2, vt2, k2, c2, loss = _step_sync(w, vt, k, center,
+                                                       xb, yb)
+                    return (w2, vt2, k2, c2), loss
+
+                def _loc(ops):
+                    w, vt, k, center = ops
+                    w2, vt2, k2, loss = _local(w, vt, k, xb, yb)
+                    return (w2, vt2, k2, center), loss
+
+                # Sync schedule from the device-resident counter (k rows
+                # advance in lockstep; row 0 stands for all).  Fresh runs
+                # match step()'s host-side ``_steps % su`` schedule
+                # exactly; resumed runs continue the *global* schedule,
+                # which step() (counting from process start) does not.
+                return jax.lax.cond(
+                    (k[0] % self.su) == 0, _sync, _loc, (w, vt, k, center)
+                )
+
+            (w, vt, k, center), losses = jax.lax.scan(
+                body, (w, vt, k, center), (xs, ys)
+            )
+            return w, vt, k, center, losses
+
+        ls = NamedSharding(mesh, P())  # per-step losses, replicated
+        ebs = NamedSharding(mesh, P(None, *bs.spec))  # staged epoch batches
+        self._epoch_jit = jax.jit(
+            _epoch,
+            in_shardings=(ws, ws, ks, cs, ebs, ebs),
+            out_shardings=(ws, ws, ks, cs, ls),
+            donate_argnums=(0, 1, 2, 3),
+        )
+
     # -- state ---------------------------------------------------------------
 
     def init(self, w0: jnp.ndarray) -> Dict[str, Any]:
@@ -210,3 +256,49 @@ class MeshEASGD:
 
     def center_params(self, state: Dict[str, Any]) -> jnp.ndarray:
         return state["center"]
+
+    def run_epoch(self, state: Dict[str, Any], x_ep: jnp.ndarray,
+                  y_ep: jnp.ndarray):
+        """Train a whole staged epoch — ``(nsteps, n_dp, batch, ...)``
+        arrays already placed with the epoch sharding — in ONE jitted
+        scan.  Returns the new state and the (nsteps,) per-step losses.
+        Equivalent trajectory to ``nsteps`` :meth:`step` calls for runs
+        whose state counter started at 0 (regression-tested); the sync
+        schedule reads the device-resident counter, so a resumed run
+        continues the global schedule."""
+        w, vt, k, center, losses = self._epoch_jit(
+            state["w"], state["vt"], state["k"], state["center"], x_ep, y_ep
+        )
+        self._steps += int(x_ep.shape[0])
+        return {"w": w, "vt": vt, "k": k, "center": center}, losses
+
+    def precompile_epoch(self, state: Dict[str, Any], x_ep: jnp.ndarray,
+                         y_ep: jnp.ndarray) -> None:
+        """Compile-and-warm the whole-epoch scan program for this epoch
+        shape without consuming the caller's buffers or advancing
+        ``_steps``."""
+        cp = {k: jnp.copy(v) for k, v in state.items()}
+        out = self._epoch_jit(cp["w"], cp["vt"], cp["k"], cp["center"],
+                              x_ep, y_ep)
+        from mpit_tpu.utils.timing import fetch_scalar
+
+        fetch_scalar(out[-1])
+
+    def precompile(self, state: Dict[str, Any], *batch: jnp.ndarray) -> None:
+        """Compile-and-warm BOTH step programs (local and sync) against
+        the real state/batch shardings, without advancing the sync
+        schedule or consuming the caller's buffers.
+
+        The jits donate their state arguments, so fresh copies are run
+        through them and the outputs discarded; ``self._steps`` is
+        untouched — a subsequent :meth:`step` sequence hits the elastic
+        exchange on exactly the same schedule as an unwarmed run."""
+        cp = {k: jnp.copy(v) for k, v in state.items()}
+        self._sync_jit(cp["w"], cp["vt"], cp["k"], cp["center"], *batch)
+        cp = {k: jnp.copy(v) for k, v in state.items()}
+        out_l = self._local_jit(cp["w"], cp["vt"], cp["k"], *batch)
+        from mpit_tpu.utils.timing import fetch_scalar
+
+        # Devices execute their queue in order: fetching from the LAST
+        # enqueued program fences both executions.
+        fetch_scalar(out_l[-1])

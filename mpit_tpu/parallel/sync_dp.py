@@ -77,6 +77,26 @@ class SyncDataParallel:
             donate_argnums=(0, 1),
         )
 
+        # Whole-epoch scan: one dispatch per staged epoch (see
+        # MeshEASGD._epoch for why this matters on tunneled platforms).
+        def _epoch(w, vt, k, xs, ys):
+            def body(carry, xy):
+                w, vt, k = carry
+                w2, vt2, k2, loss = _step(w, vt, k, *xy)
+                return (w2, vt2, k2), loss
+
+            (w, vt, k), losses = jax.lax.scan(body, (w, vt, k), (xs, ys))
+            return w, vt, k, losses
+
+        rs = NamedSharding(mesh, P())
+        ebs = NamedSharding(mesh, P(None, *bs.spec))
+        self._epoch_jit = jax.jit(
+            _epoch,
+            in_shardings=(ps, ps, rs, ebs, ebs),
+            out_shardings=(ps, ps, rs, rs),
+            donate_argnums=(0, 1),
+        )
+
     def init(self, w0: jnp.ndarray) -> Dict[str, Any]:
         # Copy w0: device_put may alias the caller's buffer on the device
         # whose shard stays put, and step() donates "w" — without the copy
@@ -99,3 +119,32 @@ class SyncDataParallel:
     def step(self, state: Dict[str, Any], xb: jnp.ndarray, yb: jnp.ndarray):
         w, vt, k, loss = self._step_jit(state["w"], state["vt"], state["k"], xb, yb)
         return {"w": w, "vt": vt, "k": k}, loss
+
+    def precompile(self, state: Dict[str, Any], *batch: jnp.ndarray) -> None:
+        """Compile-and-warm the step program against the real shardings
+        without consuming the caller's buffers (the jit donates w/vt, so
+        fresh copies are run through it and discarded)."""
+        cp = {k: jnp.copy(v) for k, v in state.items()}
+        out = self._step_jit(cp["w"], cp["vt"], cp["k"], *batch)
+        from mpit_tpu.utils.timing import fetch_scalar
+
+        fetch_scalar(out[-1])
+
+    def run_epoch(self, state: Dict[str, Any], x_ep: jnp.ndarray,
+                  y_ep: jnp.ndarray):
+        """Train a whole staged epoch in one jitted scan; returns the new
+        state and the (nsteps,) per-step losses."""
+        w, vt, k, losses = self._epoch_jit(
+            state["w"], state["vt"], state["k"], x_ep, y_ep
+        )
+        return {"w": w, "vt": vt, "k": k}, losses
+
+    def precompile_epoch(self, state: Dict[str, Any], x_ep: jnp.ndarray,
+                         y_ep: jnp.ndarray) -> None:
+        """Compile-and-warm the whole-epoch scan for this epoch shape
+        without consuming the caller's buffers."""
+        cp = {k: jnp.copy(v) for k, v in state.items()}
+        out = self._epoch_jit(cp["w"], cp["vt"], cp["k"], x_ep, y_ep)
+        from mpit_tpu.utils.timing import fetch_scalar
+
+        fetch_scalar(out[-1])

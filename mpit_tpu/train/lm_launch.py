@@ -44,7 +44,11 @@ LM_LAUNCH_DEFAULTS = Config(
     mom=0.9,
     dp=0,  # 0 -> 1 (all devices on sp)
     sp=0,  # 0 -> all remaining devices
-    layout="contiguous",  # contiguous | zigzag causal ring layout
+    # Causal ring layout: zigzag is the default because the causal ring's
+    # wall clock is set by its busiest device and the zigzag (early+late
+    # half-chunk) layout cuts that device's work 1.74x measured
+    # (docs/KERNEL_BENCH.md §3); contiguous remains for ablation.
+    layout="zigzag",  # zigzag | contiguous
     attn_dtype="bfloat16",  # kernel input dtype: bfloat16 | float32
     text_file="",
     seed=1,

@@ -64,6 +64,32 @@ def ensure_cpu_device_headroom(n_mesh_devices: int, extra: int = CPU_POOL_HEADRO
     os.environ["MPIT_MESH_DEVICES"] = str(n_mesh_devices)
 
 
+def enable_compile_cache(path: str | None = None) -> str:
+    """Point jax at a persistent compilation cache and drop the size/time
+    thresholds so every program is cached.
+
+    Motivation: on the tunneled-TPU platform a cold jit of the flagship
+    trainer costs ~13 s of the north-star's wall-clock-to-target; a warm
+    persistent cache turns that into ~0.3 s of deserialization (measured:
+    9.15 s -> 0.35 s for a first jit call in a fresh process).  Safe to
+    call any time before the first compile; idempotent.
+
+    Resolution order: explicit ``path`` > ``MPIT_COMPILE_CACHE`` env >
+    ``.jax_cache/`` next to the repo root (derived from this package's
+    location).  Returns the directory used.
+    """
+    import pathlib
+
+    import jax
+
+    cache = (path or os.environ.get("MPIT_COMPILE_CACHE")
+             or str(pathlib.Path(__file__).resolve().parents[2] / ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return cache
+
+
 def default_devices():
     """The device pool meshes should span: the first ``MPIT_MESH_DEVICES``
     of ``jax.devices()`` when that env var is set *and* the pool is the

@@ -54,6 +54,7 @@ def timed_per_call(
     repeats: int = 3,
     auto_scale: bool = False,
     max_iters: int = 2000,
+    min_ratio: float = 1.0,
 ) -> float:
     """Seconds per call of ``fn(*args)`` on device, latency-cancelled.
 
@@ -71,6 +72,13 @@ def timed_per_call(
     the measurement reruns, up to ``max_iters`` — fast ops on a loaded
     host otherwise difference two minima into a ≤0 estimate.  The result
     is always floored at :data:`MIN_RESOLVABLE_S`.
+
+    ``min_ratio`` sharpens the stop rule: ``delta > min_ratio * jitter``.
+    The default (1) only guarantees signal exceeds noise — up to ~100%
+    relative error.  Callers that publish the number should pass 5-10:
+    the relative error is bounded by roughly ``jitter/delta <
+    1/min_ratio`` (measured on the tunnel: min_ratio=1 let one rep of a
+    ~2.9 ms op read 1.7x fast; min_ratio=8 held reps within a few %).
     """
     fetch_scalar(fn(*args))  # compile + warm
 
@@ -91,7 +99,8 @@ def timed_per_call(
         bigs = [run(base_iters + iters) for _ in range(repeats)]
         delta = min(bigs) - min(smalls)
         jitter = max(max(smalls) - min(smalls), max(bigs) - min(bigs))
-        if (not auto_scale or delta > jitter or iters * 2 > max_iters):
+        if (not auto_scale or delta > min_ratio * jitter
+                or iters * 2 > max_iters):
             return max(delta, MIN_RESOLVABLE_S * iters) / iters
         iters *= 2
 
@@ -103,13 +112,18 @@ def timed_chained(
     iters: int = 10,
     base_iters: int = 1,
     repeats: int = 3,
+    auto_scale: bool = False,
+    max_iters: int = 2000,
+    min_ratio: float = 1.0,
 ) -> float:
     """Like :func:`timed_per_call` for state-threading calls:
     ``state = fn(state, *args)`` each iteration.  This is the honest way
     to time donated/in-place update kernels — calling them repeatedly on
     the *same* buffers would either fault (donated input reuse) or force
     the runtime to insert defensive copies that a real training loop
-    never pays.  Per-leg minima, as in :func:`timed_per_call`."""
+    never pays.  Per-leg minima and ``auto_scale`` semantics as in
+    :func:`timed_per_call` (state keeps threading through escalation
+    rounds — fine for update steps, whose cost is state-independent)."""
     state = fn(state, *args)  # compile + warm
     fetch_scalar(state)
 
@@ -120,10 +134,16 @@ def timed_chained(
         fetch_scalar(st)
         return time.perf_counter() - t0, st
 
-    smalls, bigs = [], []
-    for _ in range(repeats):
-        t_small, state = run(base_iters, state)
-        smalls.append(t_small)
-        t_big, state = run(base_iters + iters, state)
-        bigs.append(t_big)
-    return max(min(bigs) - min(smalls), MIN_RESOLVABLE_S * iters) / iters
+    while True:
+        smalls, bigs = [], []
+        for _ in range(repeats):
+            t_small, state = run(base_iters, state)
+            smalls.append(t_small)
+            t_big, state = run(base_iters + iters, state)
+            bigs.append(t_big)
+        delta = min(bigs) - min(smalls)
+        jitter = max(max(smalls) - min(smalls), max(bigs) - min(bigs))
+        if (not auto_scale or delta > min_ratio * jitter
+                or iters * 2 > max_iters):
+            return max(delta, MIN_RESOLVABLE_S * iters) / iters
+        iters *= 2

@@ -42,15 +42,20 @@ def test_syncdp_trains_to_target():
     ("syncdp", dict(lr=0.2, mom=0.9, batch=64)),
 ])
 def test_device_stream_trains_identically(opt, kw):
-    """Staging an epoch in HBM must change where batches are assembled,
-    not what is trained: same seed -> same per-epoch losses and errors
-    as the per-step host path."""
+    """Staging an epoch in HBM — and collapsing it into one jitted scan
+    — must change where/how batches are dispatched, not what is trained:
+    same seed -> same per-epoch losses and errors as the per-step host
+    path, for both the scan (epoch_scan=1, default) and step-loop
+    (epoch_scan=0) staged variants."""
     host = run(_tiny_cfg(opt=opt, **kw))
-    staged = run(_tiny_cfg(opt=opt, device_stream=1, **kw))
-    for h, s in zip(host["history"], staged["history"]):
-        np.testing.assert_allclose(s["avg_loss"], h["avg_loss"],
-                                   rtol=1e-5, atol=1e-6)
-        np.testing.assert_allclose(s["test_err"], h["test_err"], atol=1e-6)
+    scan = run(_tiny_cfg(opt=opt, device_stream=1, **kw))
+    steploop = run(_tiny_cfg(opt=opt, device_stream=1, epoch_scan=0, **kw))
+    for variant in (scan, steploop):
+        for h, s in zip(host["history"], variant["history"]):
+            np.testing.assert_allclose(s["avg_loss"], h["avg_loss"],
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(s["test_err"], h["test_err"],
+                                       atol=1e-6)
 
 
 def test_measure_throughput_reports_steady_rate():
