@@ -66,7 +66,14 @@ def bench_shm() -> dict:
     _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, "
          f"payload {size * 4 / 2**20:.1f} MB")
 
-    with shm_gang(f"ptest_{os.getpid()}", NSERVERS, NCLIENTS, size) as (
+    # Ring sized to hold a full per-server shard (x2 both directions,
+    # plus header slack): with the 16 MB default a 640 MB-payload
+    # transfer needs the ring drained ~20x mid-message, each handoff
+    # paying a GIL quantum on a shared core.
+    shard_bytes = size * 4 // max(NSERVERS, 1)
+    ring = max(64 << 20, 2 * shard_bytes + (16 << 20))
+    with shm_gang(f"ptest_{os.getpid()}", NSERVERS, NCLIENTS, size,
+                  ring_bytes=ring) as (
         clients, _params, _grads
     ):
         def client_rounds(i):
@@ -99,12 +106,45 @@ def bench_shm() -> dict:
     }
 
 
+def _bench_shm_subprocess() -> dict:
+    """Run the shm leg in a child with JAX_PLATFORMS=cpu: the PS server's
+    shard state must live host-side (ps/server.py device='cpu'), but
+    accelerator plugins like the axon tunnel remove the in-process CPU
+    backend — and this parent may already hold the accelerator for the
+    ici leg."""
+    import subprocess
+
+    env = dict(os.environ, MPIT_BENCH_MODE="shm", JAX_PLATFORMS="cpu")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=900,
+        )
+    except subprocess.TimeoutExpired as e:
+        # Echo whatever the child logged before the stall — it is the
+        # only evidence of where it hung.
+        for stream in (e.stdout, e.stderr):
+            if stream:
+                sys.stderr.write(stream if isinstance(stream, str)
+                                 else stream.decode(errors="replace"))
+        raise
+    sys.stderr.write(out.stderr)
+    if out.returncode != 0:
+        raise RuntimeError(f"shm child failed rc={out.returncode}")
+    lines = out.stdout.strip().splitlines()
+    if not lines:
+        raise RuntimeError("shm child exited 0 but produced no JSON output")
+    return json.loads(lines[-1])
+
+
 def main():
     results = []
     if MODE in ("ici", "both"):
         results.append(bench_ici())
-    if MODE in ("shm", "both"):
+    if MODE == "shm":
         results.append(bench_shm())
+    elif MODE == "both":
+        results.append(_bench_shm_subprocess())
     for r in results:
         print(json.dumps(r))
 

@@ -15,10 +15,23 @@ re-raised from :meth:`Scheduler.wait` / :meth:`Scheduler.wait_for`.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Generator, Optional
 
 from mpit_tpu.aio.queue import Queue
+
+# Idle backoff (microseconds) for the wait loops: after a full pass over
+# the queue completes NO task, the waiter sleeps this long before polling
+# again.  On a host whose roles share cores (colocated server/client
+# threads, 1-core CI boxes) a busy-spinning waiter steals exactly the
+# cycles its peer needs to make the data arrive — the 1-core shm PS
+# bench sweep measured (MB/s aggregate at 64 MB payload): 0us -> 298,
+# 100us -> 368, 200-300us -> ~400, with diminishing returns and growing
+# small-message latency beyond.  A pass that moves chunks but completes
+# nothing still sleeps; at 4 MB chunks the duty cycle stays far above
+# wire speed.  0 disables.
+IDLE_USEC = float(os.environ.get("MPIT_AIO_IDLE_USEC", "200"))
 
 # Task signals (reference init.lua:21-25).  INIT/OK are retained for state
 # reporting; the scheduler itself only reacts to EXEC (keep going) vs DONE.
@@ -90,9 +103,11 @@ class Scheduler:
     co_ping (init.lua:147-174), ``wait`` = co_wait (init.lua:178-185).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, idle_usec: Optional[float] = None) -> None:
         self.queue: Queue[Task] = Queue()
         self.errors: list[TaskError] = []
+        self.idle_usec = IDLE_USEC if idle_usec is None else float(idle_usec)
+        self._completions = 0
 
     # -- co_execute ---------------------------------------------------------
     def spawn(
@@ -120,6 +135,23 @@ class Scheduler:
         self._step_and_requeue(task)
         return task
 
+    def ping_pass(self, usec: float = 0.0) -> bool:
+        """One full pass over the current queue (one ping per queued
+        task), then the idle backoff when the pass completed no task.
+        Returns True when anything completed.  The single building block
+        of every wait loop — the backoff rule lives here only."""
+        done0 = self._completions
+        for _ in range(len(self.queue)):
+            self.ping()
+            if usec > 0:
+                time.sleep(usec * 1e-6)
+        progressed = self._completions != done0
+        if self.idle_usec > 0 and self.queue and not progressed:
+            # Full pass, nothing finished: yield the core (see IDLE_USEC)
+            # instead of burning it on iprobe spins.
+            time.sleep(self.idle_usec * 1e-6)
+        return progressed
+
     # -- co_wait ------------------------------------------------------------
     def wait(self, usec: float = 0.0, deadline: Optional[float] = None) -> None:
         """Drain the queue, optionally sleeping ``usec`` microseconds after
@@ -132,9 +164,7 @@ class Scheduler:
         """
         t_end = None if deadline is None else time.monotonic() + deadline
         while self.queue:
-            self.ping()
-            if usec > 0:
-                time.sleep(usec * 1e-6)
+            self.ping_pass(usec)
             if t_end is not None and time.monotonic() > t_end and self.queue:
                 raise TimeoutError(
                     f"scheduler.wait: {len(self.queue)} task(s) still pending "
@@ -148,9 +178,7 @@ class Scheduler:
         while task.state not in (DONE, ERR):
             if not self.queue:
                 raise RuntimeError(f"task {task.name!r} pending but queue empty")
-            self.ping()
-            if usec > 0:
-                time.sleep(usec * 1e-6)
+            self.ping_pass(usec)
         if task.state == ERR:
             # Drop the queued duplicate so a later wait() doesn't re-raise
             # an error the caller already handled here.
@@ -163,7 +191,10 @@ class Scheduler:
         if state == EXEC:
             self.queue.push(task)
         elif state == ERR:
+            self._completions += 1
             self.errors.append(TaskError(task, task.error))  # type: ignore[arg-type]
+        elif state == DONE:
+            self._completions += 1
 
     def __len__(self) -> int:
         return len(self.queue)

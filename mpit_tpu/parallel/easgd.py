@@ -276,7 +276,14 @@ class MeshEASGD:
                          y_ep: jnp.ndarray) -> None:
         """Compile-and-warm the whole-epoch scan program for this epoch
         shape without consuming the caller's buffers or advancing
-        ``_steps``."""
+        ``_steps``.
+
+        Deliberately EXECUTES the program (on copied state) rather than
+        AOT ``lower().compile()``: AOT compilation does not populate the
+        jit's dispatch cache, so the first timed epoch would still pay
+        tracing + cache deserialization — exactly the cost this warmup
+        exists to move before t0.  One warm scan pass is milliseconds of
+        device compute; the copies are transient."""
         cp = {k: jnp.copy(v) for k, v in state.items()}
         out = self._epoch_jit(cp["w"], cp["vt"], cp["k"], cp["center"],
                               x_ep, y_ep)

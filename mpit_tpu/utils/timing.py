@@ -46,6 +46,28 @@ def fetch_scalar(out: Any) -> float:
 MIN_RESOLVABLE_S = 1e-9
 
 
+def _auto_scaled_estimate(
+    measure: Callable[[int], tuple[list, list]],
+    iters: int,
+    auto_scale: bool,
+    max_iters: int,
+    min_ratio: float,
+) -> float:
+    """Shared escalation loop of both timing helpers.  ``measure(iters)``
+    returns (small-leg times, big-leg times); the per-call estimate is
+    the difference of the per-leg minima, and ``iters`` doubles until
+    that difference clears ``min_ratio`` x the observed per-leg jitter
+    (or ``max_iters``).  Floored at :data:`MIN_RESOLVABLE_S`."""
+    while True:
+        smalls, bigs = measure(iters)
+        delta = min(bigs) - min(smalls)
+        jitter = max(max(smalls) - min(smalls), max(bigs) - min(bigs))
+        if (not auto_scale or delta > min_ratio * jitter
+                or iters * 2 > max_iters):
+            return max(delta, MIN_RESOLVABLE_S * iters) / iters
+        iters *= 2
+
+
 def timed_per_call(
     fn: Callable[..., Any],
     *args: Any,
@@ -90,19 +112,17 @@ def timed_per_call(
         fetch_scalar(out)
         return time.perf_counter() - t0
 
-    while True:
+    def measure(n: int):
         # the small leg is deliberately re-measured every escalation
         # round: its minimum and spread anchor the jitter estimate, and
         # host load drifts over the seconds an escalated measurement
         # takes — stale smalls would difference against old conditions.
         smalls = [run(base_iters) for _ in range(repeats)]
-        bigs = [run(base_iters + iters) for _ in range(repeats)]
-        delta = min(bigs) - min(smalls)
-        jitter = max(max(smalls) - min(smalls), max(bigs) - min(bigs))
-        if (not auto_scale or delta > min_ratio * jitter
-                or iters * 2 > max_iters):
-            return max(delta, MIN_RESOLVABLE_S * iters) / iters
-        iters *= 2
+        bigs = [run(base_iters + n) for _ in range(repeats)]
+        return smalls, bigs
+
+    return _auto_scaled_estimate(measure, iters, auto_scale, max_iters,
+                                 min_ratio)
 
 
 def timed_chained(
@@ -134,16 +154,16 @@ def timed_chained(
         fetch_scalar(st)
         return time.perf_counter() - t0, st
 
-    while True:
+    st = [state]  # threaded through every leg across escalation rounds
+
+    def measure(n: int):
         smalls, bigs = [], []
         for _ in range(repeats):
-            t_small, state = run(base_iters, state)
+            t_small, st[0] = run(base_iters, st[0])
             smalls.append(t_small)
-            t_big, state = run(base_iters + iters, state)
+            t_big, st[0] = run(base_iters + n, st[0])
             bigs.append(t_big)
-        delta = min(bigs) - min(smalls)
-        jitter = max(max(smalls) - min(smalls), max(bigs) - min(bigs))
-        if (not auto_scale or delta > min_ratio * jitter
-                or iters * 2 > max_iters):
-            return max(delta, MIN_RESOLVABLE_S * iters) / iters
-        iters *= 2
+        return smalls, bigs
+
+    return _auto_scaled_estimate(measure, iters, auto_scale, max_iters,
+                                 min_ratio)
