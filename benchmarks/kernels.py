@@ -66,10 +66,17 @@ def _emit(rec: dict) -> None:
 def _time(fn, *args, iters=ITERS):
     """Latency-cancelled per-call device time — see
     :mod:`mpit_tpu.utils.timing` for why block_until_ready timing is
-    unusable on tunneled platforms."""
+    unusable on tunneled platforms.  Bounded auto_scale: sub-ms ops at
+    fixed iters once printed an absurd 0.0 ms row, so the legs escalate
+    until the delta clears 3x jitter — but the cap stays small (4x the
+    requested iters) because per-dispatch HOST cost on a tunnel grows
+    with the leg length, so jitter grows with iters and an aggressive
+    ratio (8x) escalates every ~ms-scale measurement to the global cap,
+    turning one kernel table into a ~45-minute stall (observed)."""
     from mpit_tpu.utils.timing import timed_per_call
 
-    return timed_per_call(fn, *args, iters=iters)
+    return timed_per_call(fn, *args, iters=iters, auto_scale=True,
+                          min_ratio=3.0, max_iters=max(4 * iters, 64))
 
 
 def _try_time(fn, *args, what=""):

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Tuple
 
 import jax
@@ -46,6 +47,27 @@ from mpit_tpu.ops.tiles import (
 )
 
 NEG_INF = float("-inf")
+
+# In-kernel running-max sentinel.  A FINITE very-negative value instead
+# of -inf: every `isneginf` guard in the hot loop disappears (exp of
+# (-1e30 - x) underflows to exactly 0, which is what the guards
+# computed), worth ~4 MFU points on-chip; the partial outputs convert
+# back to -inf at finalize so the public (acc, m, l) contract — and the
+# merge/LSE algebra built on isneginf — is unchanged.
+_BIG_NEG = -1e30
+
+
+def _fa_compiler_params():
+    """Grid dimension semantics for every flash kernel: the first grid
+    axis (q rows fwd/dq, kv rows dk/dv) is embarrassingly parallel, the
+    second is the sequential accumulation sweep over VMEM scratch.
+    Declaring this lets Mosaic schedule the parallel axis freely.
+    MPIT_FA_DIMSEM=0 reverts to unannotated grids (A/B lever)."""
+    if os.environ.get("MPIT_FA_DIMSEM", "1") == "0":
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary")
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -139,6 +161,28 @@ def finalize_partials(acc, l, dtype=jnp.float32):
 # ---------------------------------------------------------------------------
 
 
+def _block_bounds(qoff_ref, kvoff_ref, kvlen_ref, i, j, *, causal,
+                  block_q, block_k):
+    """(live, full) triage for the (i, j) tile — the ONE copy of the
+    off-by-one-sensitive causal boundary rule, shared by forward and
+    both backward kernels: dead blocks skip everything, full blocks take
+    the mask-free fast path, edge (diagonal / kv_len-straddling) blocks
+    mask."""
+    q_lo = qoff_ref[0, 0] + i * block_q
+    k_hi_local = (j + 1) * block_k  # exclusive
+    live = j * block_k < kvlen_ref[0, 0]
+    full = k_hi_local <= kvlen_ref[0, 0]
+    if causal:
+        q_max = q_lo + (block_q - 1)
+        k_min = kvoff_ref[0, 0] + j * block_k
+        live = jnp.logical_and(live, q_max >= k_min)
+        # fully live: even the block's last key is <= the first query row
+        full = jnp.logical_and(
+            full, q_lo >= kvoff_ref[0, 0] + k_hi_local - 1
+        )
+    return live, full
+
+
 def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
                *rest, causal, scale, block_q, block_k, partial, precision):
     if partial:
@@ -151,55 +195,76 @@ def _fa_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j == 0)
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        m_scr[:] = jnp.full_like(m_scr, _BIG_NEG)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    # Skip blocks with no live element: entirely past kv_len padding, or
-    # (causal) entirely above the diagonal — the scratch carries through
-    # unchanged, saving the MXU work for ~half the blocks of a causal
-    # sweep.
-    live = j * block_k < kvlen_ref[0, 0]
-    if causal:
-        q_max = qoff_ref[0, 0] + i * block_q + (block_q - 1)
-        k_min = kvoff_ref[0, 0] + j * block_k
-        live = jnp.logical_and(live, q_max >= k_min)
+    # Block triage (see _block_bounds): shaving the mask passes on
+    # interior blocks is a direct win because the per-tile cost is the
+    # VPU's dependent chain, not the MXU.
+    live, full = _block_bounds(
+        qoff_ref, kvoff_ref, kvlen_ref, i, j,
+        causal=causal, block_q=block_q, block_k=block_k,
+    )
+    q_lo = qoff_ref[0, 0] + i * block_q
 
-    @pl.when(live)
-    def _block():
-        qf = q_ref[:].astype(jnp.float32)
-        kf = k_ref[:].astype(jnp.float32)
+    def _block(masked):
         s = jax.lax.dot_general(
-            qf, kf, (((1,), (1,)), ((), ())),
+            q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
-        ) * scale  # (block_q, block_k)
+        ) * scale  # (block_q, block_k) f32
 
-        qi = (qoff_ref[0, 0] + i * block_q
-              + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
-        kj_local = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = kj_local < kvlen_ref[0, 0]
-        if causal:
-            valid = valid & (qi >= kvoff_ref[0, 0] + kj_local)
-        s = jnp.where(valid, s, NEG_INF)
+        if masked:
+            qi = (q_lo
+                  + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            kj_local = (j * block_k
+                        + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            valid = kj_local < kvlen_ref[0, 0]
+            if causal:
+                valid = valid & (qi >= kvoff_ref[0, 0] + kj_local)
+            s = jnp.where(valid, s, _BIG_NEG)
 
+        # Finite sentinel algebra: m_new >= any valid score, so
+        # exp(s - m_new) <= 1 always; rows with no valid score so far
+        # keep m == _BIG_NEG and exp underflows to 0 — no isneginf
+        # guards anywhere on the dependent path.
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.where(valid, jnp.exp(s - m_safe), 0.0)
-        alpha = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_safe))
+        p = jnp.exp(s - m_new)
+        if masked:
+            # A row with NO valid score keeps m_new == _BIG_NEG, making
+            # exp(s - m_new) = exp(0) = 1 at its masked positions — the
+            # where() zeroes those (edge blocks only; the fast path
+            # never has dead rows).
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
         acc_scr[:] = acc_scr[:] * alpha + pv
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        # lane-0 writes: only column 0 is ever read back (and only
+        # column 0 of the partial outputs is consumed) — broadcasting
+        # the row stats across all 128 lanes cost a full VPU pass each.
+        m_scr[:, :1] = m_new
+        l_scr[:, :1] = l_new
+
+    @pl.when(jnp.logical_and(live, full))
+    def _fast():
+        _block(masked=False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
+    def _edge():
+        _block(masked=True)
 
     @pl.when(j == nj - 1)
     def _finalize():
         if partial:
             o_ref[:] = acc_scr[:]
-            m_out[:] = m_scr[:]
+            # Restore the public sentinel: dead rows report m = -inf
+            # (what merge_partials/_lse_of key on), not the internal
+            # finite _BIG_NEG.  One where per FINAL block only.
+            m_out[:] = jnp.where(m_scr[:] == _BIG_NEG, NEG_INF, m_scr[:])
             l_out[:] = l_scr[:]
         else:
             l = l_scr[:, :1]
@@ -285,6 +350,7 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
             pltpu.VMEM((bq, LANE), jnp.float32),
         ],
         interpret=_interpret(interpret),
+        compiler_params=_fa_compiler_params(),
     )(
         jnp.asarray(q_offset, jnp.int32).reshape(1, 1),
         jnp.asarray(kv_offset, jnp.int32).reshape(1, 1),
@@ -344,32 +410,39 @@ def flash_attention_partial(
 
 def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
               qoff_ref, kvoff_ref, kvlen_ref, i, j, *,
-              causal, scale, block_q, block_k, precision):
-    """Shared block math: recompute P and dS for the (i, j) tile."""
-    qf = q_ref[:].astype(jnp.float32)
-    kf = k_ref[:].astype(jnp.float32)
+              causal, scale, block_q, block_k, precision, masked):
+    """Shared block math: recompute P and dS for the (i, j) tile.
+    Matmul inputs stay in their native dtype (bf16 runs the MXU at full
+    rate); softmax/derivative algebra is f32.  ``masked=False`` is the
+    interior-block fast path: every element is valid by construction, so
+    the iota/compare/where mask algebra is skipped entirely."""
     s = jax.lax.dot_general(
-        qf, kf, (((1,), (1,)), ((), ())),
+        q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=precision,
-    ) * scale  # (block_q, block_k)
+    ) * scale  # (block_q, block_k) f32
 
-    qi = (qoff_ref[0, 0] + i * block_q
-          + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
-    kj_local = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    valid = kj_local < kvlen_ref[0, 0]
-    if causal:
-        valid = valid & (qi >= kvoff_ref[0, 0] + kj_local)
-
-    # exp(s - lse) is only read where valid; all-masked rows have
-    # lse = -inf and no valid element, so the inf branch is never taken.
-    p = jnp.where(valid, jnp.exp(s - lse_ref[:, :1]), 0.0)
-    dof = do_ref[:].astype(jnp.float32)
+    if masked:
+        qi = (qoff_ref[0, 0] + i * block_q
+              + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        kj_local = (j * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        valid = kj_local < kvlen_ref[0, 0]
+        if causal:
+            valid = valid & (qi >= kvoff_ref[0, 0] + kj_local)
+        # exp(s - lse) is only read where valid; all-masked rows have
+        # lse = -inf and no valid element, so the inf branch is never
+        # taken.
+        p = jnp.where(valid, jnp.exp(s - lse_ref[:, :1]), 0.0)
+    else:
+        # Full blocks contain no dead row (a dead row has no valid key
+        # anywhere), so lse is finite and exp needs no guard.
+        p = jnp.exp(s - lse_ref[:, :1])
     dp = jax.lax.dot_general(
-        dof, v_ref[:].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=precision,
-    )  # (block_q, block_k)
+    )  # (block_q, block_k) f32
     ds = p * (dp - delta_ref[:, :1])
-    return p, ds, qf, dof
+    return p, ds
 
 
 def _fa_bwd_dq_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, do_ref,
@@ -382,24 +455,30 @@ def _fa_bwd_dq_kernel(qoff_ref, kvoff_ref, kvlen_ref, q_ref, do_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = j * block_k < kvlen_ref[0, 0]
-    if causal:
-        q_max = qoff_ref[0, 0] + i * block_q + (block_q - 1)
-        k_min = kvoff_ref[0, 0] + j * block_k
-        live = jnp.logical_and(live, q_max >= k_min)
+    live, full = _block_bounds(
+        qoff_ref, kvoff_ref, kvlen_ref, i, j,
+        causal=causal, block_q=block_q, block_k=block_k,
+    )
 
-    @pl.when(live)
-    def _block():
-        _, ds, _, _ = _bwd_p_ds(
+    def _block(masked):
+        _, ds = _bwd_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qoff_ref, kvoff_ref, kvlen_ref, i, j,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-            precision=precision,
+            precision=precision, masked=masked,
         )
         dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
-            ds, k_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
+
+    @pl.when(jnp.logical_and(live, full))
+    def _fast():
+        _block(masked=False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
+    def _edge():
+        _block(masked=True)
 
     @pl.when(j == nj - 1)
     def _finalize():
@@ -418,28 +497,34 @@ def _fa_bwd_dkdv_kernel(qoff_ref, kvoff_ref, kvlen_ref, k_ref, v_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = j * block_k < kvlen_ref[0, 0]
-    if causal:
-        q_max = qoff_ref[0, 0] + i * block_q + (block_q - 1)
-        k_min = kvoff_ref[0, 0] + j * block_k
-        live = jnp.logical_and(live, q_max >= k_min)
+    live, full = _block_bounds(
+        qoff_ref, kvoff_ref, kvlen_ref, i, j,
+        causal=causal, block_q=block_q, block_k=block_k,
+    )
 
-    @pl.when(live)
-    def _block():
-        p, ds, qf, dof = _bwd_p_ds(
+    def _block(masked):
+        p, ds = _bwd_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qoff_ref, kvoff_ref, kvlen_ref, i, j,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-            precision=precision,
+            precision=precision, masked=masked,
         )
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, dof, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
         dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
-            ds, qf, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[:], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision,
         )
+
+    @pl.when(jnp.logical_and(live, full))
+    def _fast():
+        _block(masked=False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
+    def _edge():
+        _block(masked=True)
 
     @pl.when(i == ni - 1)
     def _finalize():
@@ -496,6 +581,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
         out_shape=jax.ShapeDtypeStruct((lq_p, d_p), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
         interpret=interp,
+        compiler_params=_fa_compiler_params(),
     )(*scalars, qp, dop, lse_r, delta_r, kp, vp)
 
     # Kernel 2: dK/dV — kv blocks outer, q rows inner.
@@ -517,6 +603,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
             pltpu.VMEM((bk, d_p), jnp.float32),
         ],
         interpret=interp,
+        compiler_params=_fa_compiler_params(),
     )(*scalars, kp, vp, qp, dop, lse_r, delta_r)
 
     return dq[:lq, :d], dk[:lk, :d], dv[:lk, :d]

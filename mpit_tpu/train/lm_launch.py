@@ -51,6 +51,7 @@ LM_LAUNCH_DEFAULTS = Config(
     layout="zigzag",  # zigzag | contiguous
     attn_dtype="bfloat16",  # kernel input dtype: bfloat16 | float32
     text_file="",
+    compile_cache=1,  # persistent XLA compilation cache (utils.platform)
     seed=1,
     log_every=20,
     ckpt_dir="",
@@ -132,6 +133,10 @@ def run(cfg: Config) -> dict:
     from mpit_tpu.utils.platform import default_devices
 
     log = get_logger("lm", pg.process_id)
+    if cfg.compile_cache:
+        from mpit_tpu.utils.platform import enable_compile_cache
+
+        log.info("compile cache: %s", enable_compile_cache())
     devs = default_devices()
     dp = int(cfg.dp) or 1
     sp = int(cfg.sp) or len(devs) // dp

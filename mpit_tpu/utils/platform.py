@@ -87,6 +87,12 @@ def enable_compile_cache(path: str | None = None) -> str:
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # LRU eviction cap: with the thresholds dropped, every compiled
+    # program lands in the cache (the test suite alone writes hundreds
+    # of tiny CPU executables per run) and jax never evicts by default.
+    jax.config.update("jax_compilation_cache_max_size",
+                      int(os.environ.get("MPIT_COMPILE_CACHE_MAX",
+                                         str(2 << 30))))
     return cache
 
 

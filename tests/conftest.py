@@ -31,6 +31,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the whole suite: the expensive
+# tests are compile-dominated (sharded ring-attention grad graphs), and
+# re-running the suite recompiles identical programs.  Same cache dir as
+# the trainers (repo-local .jax_cache, gitignored) — a fresh clone runs
+# cold once.  Disable with MPIT_TEST_COMPILE_CACHE=0.
+if os.environ.get("MPIT_TEST_COMPILE_CACHE", "1") != "0":
+    from mpit_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
