@@ -359,6 +359,23 @@ _TOPICS = ["ocean", "mountain", "forest", "desert", "river", "valley",
            "glacier", "volcano", "prairie", "island"]
 
 
+DOCQA_EMBEDDING_DIM = 50
+
+
+def docqa_paths() -> Optional[Dict[str, pathlib.Path]]:
+    """The committed REAL corpus (``data/fixtures/docqa``): answer
+    selection over Python-stdlib docstrings — question = dotted name +
+    parameter names, answer = the docstring's first sentence, 20-way
+    candidate pools (built by ``tools/make_docqa.py``, deterministic).
+    Returns None when the fixture is absent (e.g. an installed package
+    without the repo checkout).  Embedding files are 50-dim
+    (:data:`DOCQA_EMBEDDING_DIM`)."""
+    from mpit_tpu.data.fixtures import fixtures_root
+
+    paths = corpus_paths(fixtures_root() / "docqa")
+    return paths if paths["train_file"].exists() else None
+
+
 def corpus_paths(directory: pathlib.Path) -> Dict[str, pathlib.Path]:
     """The six corpus files of a QA directory (single source of truth for
     the filenames shared by :func:`synthetic_qa` and :func:`load_qa`)."""

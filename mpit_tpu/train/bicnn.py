@@ -109,6 +109,8 @@ BICNN_DEFAULTS = Config(
     master_freq=2,
     maxrank=120,
     singlemode=False,
+    docqa=False,  # train on the committed real stdlib-docstring corpus
+    #   (data/fixtures/docqa; wins over synthetic when no --*_file given)
     # -- rebuild-only ------------------------------------------------------
     seed=1,
     loss_report_every=2000,  # bicnn.lua:414 prints every 2000 fevals
@@ -250,7 +252,10 @@ class BiCNNTrainer:
 
         self.module = BiCNN(
             vocab_size=len(data.vocab),
-            embedding_dim=cfg.embedding_dim,
+            # the data's embedding width is authoritative — a corpus
+            # loaded from files (e.g. the 50-dim docqa fixture) wins
+            # over the config default
+            embedding_dim=data.vocab.embedding_dim,
             word_hidden_dim=cfg.word_hidden_dim,
             num_filters=cfg.num_filters,
             conv_width=cfg.cont_conv_width,
@@ -311,6 +316,26 @@ class BiCNNTrainer:
                 paths={k: pathlib.Path(cfg.get(k)) for k in file_keys},
                 oov_seed=cfg.seed,
             )
+        elif cfg.get("docqa", False):
+            # The committed REAL corpus (stdlib docstrings) — its
+            # embedding files are 50-dim, overriding the config only
+            # when the config holds the untouched 100-dim default.
+            from mpit_tpu.data.qa import DOCQA_EMBEDDING_DIM, docqa_paths
+
+            paths = docqa_paths()
+            if paths is None:
+                raise FileNotFoundError(
+                    "docqa=1 but data/fixtures/docqa is absent — run "
+                    "tools/make_docqa.py or use explicit --*_file flags"
+                )
+            dim = (DOCQA_EMBEDDING_DIM
+                   if cfg.embedding_dim == BICNN_DEFAULTS.embedding_dim
+                   else cfg.embedding_dim)
+            data = load_qa(
+                embedding_dim=dim, conv_width=cfg.cont_conv_width,
+                paths=paths, oov_seed=cfg.seed,
+            )
+            data.source = "docqa fixture (real stdlib-docstring corpus)"
         else:
             data = load_qa(
                 embedding_dim=cfg.embedding_dim,

@@ -208,6 +208,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     if "--child" in argv:
         _child_main()
         return
+    # Honor JAX_PLATFORMS for the in-process np=1 path too (gang children
+    # already do): environments that pre-import an accelerator plugin
+    # otherwise ignore the env var and a CPU-intended run lands on the
+    # accelerator.
+    from mpit_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
     cfg = BICNN_LAUNCH_DEFAULTS.parse_args(argv)
     # Fail fast in the parent: a bad optimizer name or role split discovered
     # only inside a child would strand its gang peers in the stop protocol.

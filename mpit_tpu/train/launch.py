@@ -224,6 +224,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     if "--child" in argv:
         _child_main()
         return
+    # Honor JAX_PLATFORMS for the in-process np=1 path (gang children
+    # already do via train.gang).
+    from mpit_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
     cfg = LAUNCH_DEFAULTS.parse_args(argv)
     t0 = time.monotonic()
     if int(cfg.np) == 1:

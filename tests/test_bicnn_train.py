@@ -319,3 +319,28 @@ class TestTopologies:
         results = run_topology(5, cfg, data)
         assert results[4]["role"] == "parked"
         assert results[0]["role"] == "server"
+
+
+@pytest.mark.slow
+def test_docqa_real_corpus_learns_above_chance():
+    """BiCNN on the committed REAL corpus (stdlib docstrings): pool size
+    is 20, chance = 5%; the recorded full run (8 epochs, 200 filters)
+    reaches 58-66% (docs/NORTHSTAR_r4.md) — this bounded version must
+    clear 8x chance."""
+    from mpit_tpu.data.qa import DOCQA_EMBEDDING_DIM, docqa_paths
+    from mpit_tpu.data.qa import load_qa
+
+    paths = docqa_paths()
+    assert paths is not None, "docqa fixture missing from checkout"
+    data = load_qa(embedding_dim=DOCQA_EMBEDDING_DIM, conv_width=2,
+                   paths=paths)
+    cfg = BICNN_DEFAULTS.merged(dict(
+        optimization="sgd", learning_rate=0.05, momentum=0.9, epoch=3,
+        margin=0.1, l2reg=0.0, embedding_dim=DOCQA_EMBEDDING_DIM,
+        cont_conv_width=2, num_filters=100, word_hidden_dim=64,
+        batch_size=16, maxnegsample=20, valid_mode="none",
+        loss_report_every=10**9,
+    ))
+    tr = BiCNNTrainer(cfg, pclient=None, data=data, rank=0)
+    res = tr.run()
+    assert res["accuracy"]["valid"] > 0.4

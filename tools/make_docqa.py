@@ -1,0 +1,189 @@
+"""Build the ``docqa`` fixture: a REAL answer-selection corpus from the
+Python standard library's docstrings, in the reference's exact TSV
+formats (prepareData.lua; see mpit_tpu/data/qa.py:20-25).
+
+Every committed number in this repo previously came from a *synthetic*
+QA corpus (the environment has no network egress, so the reference's
+insuranceQA-style download is impossible).  This corpus is real,
+human-written, public-domain-redistributable text that exists offline in
+every CPython image:
+
+- **answer** = the first sentence of a public callable's docstring
+  (e.g. ``os.path.join`` -> "join one or more path components
+  intelligently");
+- **question** = the callable's dotted name + its parameter names
+  (e.g. "os path join path paths") — the lexical/semantic overlap
+  between an API's name/signature and its one-line description is the
+  learnable signal, exactly the question->answer matching task BiCNN
+  exists for (answer selection over a candidate pool, reference
+  bicnn.lua).
+
+Determinism: modules are a fixed list, members are sorted, the pool
+negatives and embedding vectors come from a seeded RNG — rerunning this
+script on the SAME CPython (PROVENANCE.json records the builder's
+version; other versions move docstrings) reproduces the committed
+fixture byte-for-byte, guarded by
+tests/test_qa_data.py::TestDocqaFixture::test_builder_is_deterministic.
+
+Usage::
+
+    python tools/make_docqa.py [out_dir]   # default data/fixtures/docqa
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pathlib
+import re
+import sys
+
+import numpy as np
+
+# Fixed module list: broad, stable, text-rich stdlib surface.  (Versions
+# move docstrings occasionally; the committed fixture is the corpus of
+# record — the builder exists for provenance, not for re-running at
+# import time.)
+MODULES = [
+    "os", "os.path", "shutil", "pathlib", "io", "re", "json", "csv",
+    "math", "cmath", "statistics", "random", "itertools", "functools",
+    "operator", "collections", "heapq", "bisect", "array", "string",
+    "textwrap", "difflib", "datetime", "calendar", "zoneinfo", "time",
+    "logging", "argparse", "configparser", "getpass", "glob", "fnmatch",
+    "tempfile", "pickle", "copy", "types", "inspect", "traceback",
+    "contextlib", "abc", "numbers", "decimal", "fractions", "socket",
+    "ipaddress", "urllib.parse", "uuid", "hashlib", "hmac", "secrets",
+    "base64", "binascii", "zlib", "gzip", "bz2", "lzma", "tarfile",
+    "zipfile", "sqlite3", "threading", "queue", "subprocess", "signal",
+    "selectors", "struct", "codecs", "unicodedata", "locale", "gettext",
+    "html", "xml.etree.ElementTree", "email.utils", "mimetypes",
+    "http.client", "ftplib", "smtplib", "shlex", "platform", "sysconfig",
+    "warnings", "weakref", "gc", "ast", "dis", "tokenize", "keyword",
+    "linecache", "filecmp", "stat", "pstats", "timeit", "typing",
+    "dataclasses", "enum", "graphlib", "pprint", "reprlib",
+]
+
+_WORD = re.compile(r"[A-Za-z]+")
+EMBED_DIM = 50
+POOL_SIZE = 20
+SEED = 20260730
+
+
+def _words(text: str) -> list[str]:
+    return [w.lower() for w in _WORD.findall(text)]
+
+
+def _first_sentence(doc: str) -> str:
+    first = doc.strip().split("\n\n")[0].replace("\n", " ")
+    m = re.search(r"(?<=[a-z\)])\.\s", first)
+    return first[: m.start() + 1] if m else first
+
+
+def harvest() -> list[tuple[str, str]]:
+    """(question words, answer words) per public callable, deduplicated
+    by answer text (aliased callables appear once)."""
+    pairs: list[tuple[str, str]] = []
+    seen_answers: set[str] = set()
+    for modname in MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except Exception:
+            continue
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name, None)
+            if not callable(obj):
+                continue
+            doc = inspect.getdoc(obj)
+            if not doc:
+                continue
+            answer = " ".join(_words(_first_sentence(doc)))
+            if not (4 <= len(answer.split()) <= 40):
+                continue
+            if answer in seen_answers:
+                continue
+            seen_answers.add(answer)
+            q_words = _words(modname) + _words(name)
+            try:
+                sig = inspect.signature(obj)
+                for p in sig.parameters.values():
+                    q_words += _words(p.name)
+            except (ValueError, TypeError):
+                pass
+            question = " ".join(q_words[:16])
+            if len(question.split()) < 2:
+                continue
+            pairs.append((question, answer))
+    return pairs
+
+
+def write_fixture(out: pathlib.Path) -> dict:
+    from mpit_tpu.data.qa import corpus_paths
+
+    out.mkdir(parents=True, exist_ok=True)
+    pairs = harvest()
+    rng = np.random.default_rng(SEED)
+    order = rng.permutation(len(pairs))
+    # splits: 70% train, 10% valid, 10% test1, 10% test2
+    n = len(pairs)
+    cut = [int(n * 0.7), int(n * 0.8), int(n * 0.9)]
+    splits = np.split(order, cut)
+
+    paths = corpus_paths(out)
+    vocab = sorted({w for q, a in pairs for w in (q + " " + a).split()})
+    with open(paths["embedding_file"], "w") as fh:
+        # Deterministic random vectors; identity of rows (same word ->
+        # same vector) carries the lexical-overlap signal.  A quarter of
+        # the vocab is left out to exercise the OOV path, like the
+        # reference's partial pretrained coverage.
+        for w in vocab[: len(vocab) * 3 // 4]:
+            vec = rng.normal(size=EMBED_DIM).astype(np.float32)
+            fh.write(w + "\t" + " ".join(f"{v:.5f}" for v in vec) + "\n")
+    with open(paths["label2answ_file"], "w") as fh:
+        for lab, (_q, a) in enumerate(pairs, start=1):
+            fh.write(f"{lab}\t{a}\n")
+    with open(paths["train_file"], "w") as fh:
+        for idx in splits[0]:
+            q, a = pairs[int(idx)]
+            fh.write(f"{int(idx) + 1}\tqid\t{q}\t{a}\n")
+
+    def eval_file(path, idxs):
+        with open(path, "w") as fh:
+            for idx in idxs:
+                lab = int(idx) + 1
+                q, _a = pairs[int(idx)]
+                negatives = rng.choice(
+                    [x for x in range(1, n + 1) if x != lab],
+                    size=POOL_SIZE - 1, replace=False,
+                )
+                pool = [lab] + [int(x) for x in negatives]
+                rng.shuffle(pool)
+                fh.write(f"{lab}\t{q}\t" + " ".join(map(str, pool)) + "\n")
+
+    eval_file(paths["valid_file"], splits[1])
+    eval_file(paths["test_file1"], splits[2])
+    eval_file(paths["test_file2"], splits[3])
+    stats = {"pairs": n, "train": len(splits[0]), "valid": len(splits[1]),
+             "test1": len(splits[2]), "test2": len(splits[3]),
+             "vocab": len(vocab)}
+    import json
+    import platform
+
+    (out / "PROVENANCE.json").write_text(json.dumps({
+        "builder": "tools/make_docqa.py", "seed": SEED,
+        "python": platform.python_version(),
+        "source": "CPython stdlib docstrings (PSF license)",
+        **stats,
+    }, indent=2) + "\n")
+    return stats
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    out = pathlib.Path(
+        sys.argv[1] if len(sys.argv) > 1 else
+        pathlib.Path(__file__).resolve().parents[1] / "data/fixtures/docqa"
+    )
+    stats = write_fixture(out)
+    print(f"docqa fixture at {out}: {stats}")
