@@ -532,6 +532,68 @@ def _fa_bwd_dkdv_kernel(qoff_ref, kvoff_ref, kvlen_ref, k_ref, v_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _fa_bwd_fused_kernel(qoff_ref, kvoff_ref, kvlen_ref, k_ref, v_ref,
+                         q_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr, *,
+                         causal, scale, block_q, block_k, precision):
+    """Single-sweep backward: grid (kv outer, q inner) producing dK/dV
+    (accumulated in VMEM scratch) AND the dQ contribution of this kv
+    block (written once per program into a (n_kv_blocks, Lq, D) partial
+    that the caller sums).  Folds the separate dq kernel's s/P/dS
+    recomputation away: 5 matmuls per tile pair instead of 7."""
+    j, i = pl.program_id(0), pl.program_id(1)  # kv outer, q inner
+    ni = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live, full = _block_bounds(
+        qoff_ref, kvoff_ref, kvlen_ref, i, j,
+        causal=causal, block_q=block_q, block_k=block_k,
+    )
+
+    def _block(masked):
+        p, ds = _bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qoff_ref, kvoff_ref, kvlen_ref, i, j,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            precision=precision, masked=masked,
+        )
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        dqp_ref[0] = scale * jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(jnp.logical_and(live, full))
+    def _fast():
+        _block(masked=False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
+    def _edge():
+        _block(masked=True)
+
+    # Dead blocks still own their dq-partial slot: zero it (unwritten
+    # output blocks hold garbage).
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        dqp_ref[0] = jnp.zeros_like(dqp_ref[0])
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
 def _rows_to_lanes(x, length_p):
     """(L,) f32 row stats -> (L_p, LANE) with the value broadcast across
     lanes (the layout the kernels read back as ``ref[:, :1]``)."""
@@ -569,6 +631,44 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
               precision=precision)
     interp = _interpret(interpret)
 
+    if os.environ.get("MPIT_FA_FUSED_BWD", "1") != "0":
+        # Fused single sweep: dK/dV accumulate in VMEM, dQ leaves as
+        # per-kv-block partials — (n_kv_blocks, Lq, D) f32, each block
+        # written exactly once — summed here.  5 matmuls per tile pair
+        # vs the two-kernel schedule's 7; the partial buffer costs
+        # n_kv_blocks * Lq * D * 4 bytes of transient HBM (64 MB at
+        # L=16k, 512 MB at 32k on this shape) and one XLA reduction.
+        nj = lk_p // bk
+        kvrow2 = pl.BlockSpec((bk, d_p), lambda j, i: (j, 0),
+                              memory_space=pltpu.VMEM)
+        qrow2 = pl.BlockSpec((bq, d_p), lambda j, i: (i, 0),
+                             memory_space=pltpu.VMEM)
+        qstat2 = pl.BlockSpec((bq, LANE), lambda j, i: (i, 0),
+                              memory_space=pltpu.VMEM)
+        dqpspec = pl.BlockSpec((1, bq, d_p), lambda j, i: (j, i, 0),
+                               memory_space=pltpu.VMEM)
+        dk, dv, dq_part = pl.pallas_call(
+            functools.partial(_fa_bwd_fused_kernel, **kw),
+            grid=(nj, lq_p // bq),
+            in_specs=[sspec, sspec, sspec, kvrow2, kvrow2, qrow2, qrow2,
+                      qstat2, qstat2],
+            out_specs=(kvrow2, kvrow2, dqpspec),
+            out_shape=(
+                jax.ShapeDtypeStruct((lk_p, d_p), k.dtype),
+                jax.ShapeDtypeStruct((lk_p, d_p), v.dtype),
+                jax.ShapeDtypeStruct((nj, lq_p, d_p), jnp.float32),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((bk, d_p), jnp.float32),
+                pltpu.VMEM((bk, d_p), jnp.float32),
+            ],
+            interpret=interp,
+            compiler_params=_fa_compiler_params(),
+        )(*scalars, kp, vp, qp, dop, lse_r, delta_r)
+        dq = jnp.sum(dq_part, axis=0).astype(q.dtype)
+        return dq[:lq, :d], dk[:lk, :d], dv[:lk, :d]
+
+    # Two-kernel fallback (MPIT_FA_FUSED_BWD=0).
     # Kernel 1: dQ — q rows outer, kv blocks inner.
     qrow = pl.BlockSpec((bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
     qstat = pl.BlockSpec((bq, LANE), lambda i, j: (i, 0), memory_space=pltpu.VMEM)

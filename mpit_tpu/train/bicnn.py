@@ -298,6 +298,15 @@ class BiCNNTrainer:
 
     def _load_data(self) -> QAData:
         cfg = self.cfg
+        # Effective embedding width, resolved ONCE so every branch
+        # (binary cache validation included) agrees: docqa's 50-dim
+        # files override an untouched 100-dim config default.
+        want_dim = cfg.embedding_dim
+        if (cfg.get("docqa", False)
+                and cfg.embedding_dim == BICNN_DEFAULTS.embedding_dim):
+            from mpit_tpu.data.qa import DOCQA_EMBEDDING_DIM
+
+            want_dim = DOCQA_EMBEDDING_DIM
         cache = pathlib.Path(cfg.binary_path) if (
             cfg.preload_binary and cfg.binary_path
         ) else None
@@ -305,7 +314,7 @@ class BiCNNTrainer:
             return load_qa(
                 binary_path=cache,
                 conv_width=cfg.cont_conv_width,
-                embedding_dim=cfg.embedding_dim,
+                embedding_dim=want_dim,
             )
         file_keys = ("embedding_file", "train_file", "valid_file",
                      "test_file1", "test_file2", "label2answ_file")
@@ -317,10 +326,8 @@ class BiCNNTrainer:
                 oov_seed=cfg.seed,
             )
         elif cfg.get("docqa", False):
-            # The committed REAL corpus (stdlib docstrings) — its
-            # embedding files are 50-dim, overriding the config only
-            # when the config holds the untouched 100-dim default.
-            from mpit_tpu.data.qa import DOCQA_EMBEDDING_DIM, docqa_paths
+            # The committed REAL corpus (stdlib docstrings).
+            from mpit_tpu.data.qa import docqa_paths
 
             paths = docqa_paths()
             if paths is None:
@@ -328,11 +335,8 @@ class BiCNNTrainer:
                     "docqa=1 but data/fixtures/docqa is absent — run "
                     "tools/make_docqa.py or use explicit --*_file flags"
                 )
-            dim = (DOCQA_EMBEDDING_DIM
-                   if cfg.embedding_dim == BICNN_DEFAULTS.embedding_dim
-                   else cfg.embedding_dim)
             data = load_qa(
-                embedding_dim=dim, conv_width=cfg.cont_conv_width,
+                embedding_dim=want_dim, conv_width=cfg.cont_conv_width,
                 paths=paths, oov_seed=cfg.seed,
             )
             data.source = "docqa fixture (real stdlib-docstring corpus)"

@@ -223,6 +223,16 @@ def main(argv: Optional[List[str]] = None) -> None:
             f"unknown optimization {cfg.optimization!r}; "
             f"have {BiCNNTrainer.KNOWN_OPTS}"
         )
+    if cfg.get("docqa", False):
+        from mpit_tpu.data.qa import docqa_paths
+
+        if docqa_paths() is None:
+            raise FileNotFoundError(
+                "--docqa 1 but data/fixtures/docqa is absent — run "
+                "tools/make_docqa.py or pass explicit --*_file flags "
+                "(checked in the parent so a gang is never spawned "
+                "to fail rank by rank)"
+            )
     effective = min(int(cfg.np), int(cfg.maxrank) + 1)
     tester_flags = resolve_tester_flags(cfg)  # validate even for np=1
     if effective > 1:
