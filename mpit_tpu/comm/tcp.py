@@ -11,11 +11,13 @@ Same contract and semantics as :class:`mpit_tpu.comm.shm.ShmTransport`:
 nonblocking (rank, tag)-addressed messaging, FIFO per channel, exact-size
 receives, buffer ownership until ``test`` is True, cancel-on-shutdown.
 
-Wire format per message: 16-byte header (tag int64, size int64, little
+Wire format per message: 24-byte header (tag, size, seq — int64 little
 endian) + payload.  Connections form a full mesh at construction: every
 rank listens on its ``host:port`` from the address book; rank i dials
 every rank j < i and accepts from every j > i (each side identifies
-itself with an 8-byte rank handshake).  One reader thread per peer
+itself with a 24-byte handshake: rank, instance nonce, and — for the
+reconnect protocol — the highest sequence it has received from the
+other side).  One reader thread per peer
 drains frames into per-channel queues; sends run on a per-peer writer
 thread so ``isend`` never blocks on a slow peer.  The outbox is
 zero-copy — queued entries view the caller's buffer (owned by the
@@ -41,13 +43,23 @@ from mpit_tpu.comm.transport import (
     as_writable_view,
 )
 
-_HDR = struct.Struct("<qq")  # tag, size
-_RANK_HDR = struct.Struct("<q")
+_HDR = struct.Struct("<qqq")  # tag, size, seq
+# rank, instance nonce, last-seq-from-you, address-book digest (the
+# digest authenticates the MESH: a stale redial thread from a dead
+# transport instance, or any foreign client, that reaches a reassigned
+# port must not be installed as a peer).
+_RANK_HDR = struct.Struct("<qqqq")
+_EMPTY = memoryview(b"")
 # Reserved wire tag: an orderly close() announces itself so the peer's
 # reader can distinguish graceful shutdown (old silent-cancel semantics)
 # from a crash (fail-loud semantics).  User tags are non-negative
 # (ps/tags.py, collectives' 2^16+ range), so the sentinel can't collide.
 _GOODBYE_TAG = -(1 << 62)
+# Reserved wire tag for delivery acknowledgements (reconnect mode): the
+# header's seq field carries the highest data sequence received; no
+# payload.  Acks are neither retained nor themselves acked — a lost ack
+# is superseded by the next one or by the reconnect handshake.
+_ACK_TAG = _GOODBYE_TAG + 1
 
 
 def allocate_local_addresses(nranks: int) -> Tuple[List[str], List[socket.socket]]:
@@ -86,6 +98,17 @@ class _Channel:
 
 
 class TcpTransport(Transport):
+    """See module docstring.  ``reconnect`` (seconds, default from
+    ``MPIT_TCP_RECONNECT_S``, 0 = off) adds bounded fault recovery: on a
+    torn connection the dialing side (higher rank) redials with backoff
+    and the accepting side's persistent accept loop re-handshakes, the
+    writer resends every frame not yet fully written (frames carry
+    sequence numbers; the receiver drops duplicates), and a fresh
+    process re-binding a dead rank's address rejoins the mesh (the
+    handshake nonce tells a resumed connection from a restarted peer,
+    which resets the dedup horizon).  Only after the window expires does
+    the transport fall back to the fail-loud contract below."""
+
     def __init__(
         self,
         rank: int,
@@ -94,15 +117,39 @@ class TcpTransport(Transport):
         *,
         listener: Optional[socket.socket] = None,
         connect_timeout: float = 60.0,
+        reconnect: Optional[float] = None,
     ):
+        import os as _os
+        import secrets
+
         if len(addresses) != nranks:
             raise ValueError(f"need {nranks} addresses, got {len(addresses)}")
         self.rank = rank
         self.nranks = nranks
+        self.addresses = list(addresses)
+        self.reconnect = (
+            float(_os.environ.get("MPIT_TCP_RECONNECT_S", "0"))
+            if reconnect is None else float(reconnect)
+        )
+        self._nonce = secrets.randbits(62)
+        import hashlib
+
+        self._book_hash = int.from_bytes(
+            hashlib.blake2b(",".join(self.addresses).encode(),
+                            digest_size=7).digest(), "little")
         self._lock = threading.Lock()
         self._channels: Dict[Tuple[int, int], _Channel] = defaultdict(_Channel)
         self._peers: Dict[int, socket.socket] = {}
+        self._gen: Dict[int, int] = {r: 0 for r in range(nranks)}
+        self._peer_nonce: Dict[int, int] = {}
+        self._last_seq: Dict[int, int] = {r: 0 for r in range(nranks)}
+        self._send_seq: Dict[int, int] = {r: 0 for r in range(nranks)}
         self._outboxes: Dict[int, deque] = {r: deque() for r in range(nranks)}
+        # Reconnect mode: frames sent to the kernel but not yet
+        # acknowledged by the peer (sendall != delivered) — resent after
+        # a reconnect, released (handle.done) by acks.
+        self._unacked: Dict[int, deque] = {r: deque() for r in range(nranks)}
+        self._pending_ack: Dict[int, Any] = {}
         self._out_cv: Dict[int, threading.Condition] = {
             r: threading.Condition() for r in range(nranks)
         }
@@ -115,47 +162,148 @@ class TcpTransport(Transport):
         # polling forever on a connection that can never deliver.
         self._dead_readers: set = set()
         self._threads: List[threading.Thread] = []
+        self._disconnect_seen: set = set()
         self._closed = False
 
         host, _, port = addresses[rank].rpartition(":")
         if listener is None:
             listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            listener.bind((host or "0.0.0.0", int(port)))
+            bind_deadline = time.monotonic() + connect_timeout
+            while True:
+                try:
+                    listener.bind((host or "0.0.0.0", int(port)))
+                    break
+                except OSError as e:
+                    import errno as _errno
+
+                    # A replacement process rebinding a crashed rank's
+                    # address can race the old listener's teardown (a
+                    # thread still blocked in accept holds the port for
+                    # a moment) — retry EADDRINUSE within the window;
+                    # anything else (bad host, privileged port) is a
+                    # misconfiguration and fails immediately.
+                    if (e.errno != _errno.EADDRINUSE
+                            or time.monotonic() >= bind_deadline):
+                        raise
+                    time.sleep(0.1)
             listener.listen(nranks)
         self._listener = listener
 
         # Dial lower ranks, accept higher ranks (deadlock-free full mesh).
         deadline = time.monotonic() + connect_timeout
         for peer in range(rank):
-            self._peers[peer] = self._dial(addresses[peer], deadline)
+            conn, pnonce, peer_last = self._dial(addresses[peer], deadline,
+                                                 peer)
+            self._install_socket(peer, conn, pnonce, peer_last)
         for _ in range(nranks - rank - 1):
             conn, _addr = self._accept(deadline)
-            peer_hdr = _recv_exact(conn, _RANK_HDR.size)
-            if peer_hdr is None:
+            conn.settimeout(None)  # accepted sockets must block
+            got = self._handshake_accept(conn)
+            if got is None:
                 raise ConnectionError("peer closed during handshake")
-            (peer,) = _RANK_HDR.unpack(peer_hdr)
-            self._peers[int(peer)] = conn
-        for peer, conn in self._peers.items():
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._spawn(self._reader, peer, conn)
-            self._spawn(self._writer, peer, conn)
+            self._install_socket(got[0], conn, got[1], got[2])
+        if self.reconnect > 0:
+            self._spawn(self._accept_loop)
 
     # -- connection plumbing -------------------------------------------------
 
-    def _dial(self, address: str, deadline: float) -> socket.socket:
+    def _dial(self, address: str, deadline: float,
+              peer_rank: int) -> Tuple[socket.socket, int, int]:
+        """Returns (socket, peer nonce, peer's last-received seq from us)."""
         host, _, port = address.rpartition(":")
         last_err: Optional[Exception] = None
-        while time.monotonic() < deadline:
+        while time.monotonic() < deadline and not self._closed:
             try:
                 conn = socket.create_connection((host, int(port)), timeout=5.0)
                 conn.settimeout(None)
-                conn.sendall(_RANK_HDR.pack(self.rank))
-                return conn
+                with self._lock:
+                    my_last = self._last_seq[peer_rank]
+                conn.sendall(_RANK_HDR.pack(self.rank, self._nonce, my_last,
+                                            self._book_hash))
+                reply = _recv_exact(conn, _RANK_HDR.size)
+                if reply is None:
+                    raise ConnectionError("peer closed during handshake")
+                _prank, pnonce, peer_last, book = _RANK_HDR.unpack(reply)
+                if book != self._book_hash:
+                    conn.close()
+                    raise ConnectionError("peer belongs to a different mesh")
+                return conn, int(pnonce), int(peer_last)
             except OSError as e:  # peer not up yet
                 last_err = e
                 time.sleep(0.05)
         raise ConnectionError(f"could not reach {address}: {last_err!r}")
+
+    def _handshake_accept(
+        self, conn: socket.socket
+    ) -> Optional[Tuple[int, int, int]]:
+        """Returns (peer rank, peer nonce, peer's last seq from us)."""
+        peer_hdr = _recv_exact(conn, _RANK_HDR.size)
+        if peer_hdr is None:
+            return None
+        peer, pnonce, peer_last, book = _RANK_HDR.unpack(peer_hdr)
+        if not 0 <= peer < self.nranks or book != self._book_hash:
+            return None
+        with self._lock:
+            my_last = self._last_seq[int(peer)]
+        conn.sendall(_RANK_HDR.pack(self.rank, self._nonce, my_last,
+                                    self._book_hash))
+        return int(peer), int(pnonce), int(peer_last)
+
+    def _install_socket(self, peer: int, conn: socket.socket,
+                        pnonce: Optional[int], peer_last: int,
+                        expect_gen: Optional[int] = None) -> bool:
+        """Adopt ``conn`` as the live socket for ``peer`` (initial setup
+        and every reconnect), revive the peer's fail-loud state, settle
+        the unacked window against the peer's reported horizon, and
+        start a reader/writer generation bound to this socket.  With
+        ``expect_gen`` (a redial) the install is refused when the
+        generation moved on (another install won, or the watchdog
+        poisoned it)."""
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        cv = self._out_cv[peer]
+        with self._lock:
+            if self._closed or (expect_gen is not None
+                                and self._gen[peer] != expect_gen):
+                conn.close()
+                return False
+            old = self._peers.get(peer)
+            if pnonce is not None and self._peer_nonce.get(peer) != pnonce:
+                # A RESTARTED peer (fresh process, fresh sequence space),
+                # not a resumed connection: reset the dedup horizon.
+                self._peer_nonce[peer] = pnonce
+                self._last_seq[peer] = 0
+            self._peers[peer] = conn
+            self._gen[peer] += 1
+            gen = self._gen[peer]
+            self._dead_readers.discard(peer)
+        done_handles = []
+        with cv:
+            # Settle the unacked window: frames the peer already holds
+            # (seq <= its reported horizon) are delivered; the rest go
+            # back to the FRONT of the outbox, in order, for resend.
+            ua = self._unacked[peer]
+            resend = []
+            while ua:
+                entry = ua.popleft()
+                if entry[3] is not None and entry[3] <= peer_last:
+                    done_handles.append(entry[0])
+                else:
+                    resend.append(entry)
+            self._outboxes[peer].extendleft(reversed(resend))
+            self._dead_peers.discard(peer)
+            cv.notify_all()
+        for h in done_handles:
+            h.done = True
+            h.buf = None
+        if old is not None and old is not conn:
+            try:
+                old.close()
+            except OSError:
+                pass
+        self._spawn(self._reader, peer, conn, gen)
+        self._spawn(self._writer, peer, conn, gen)
+        return True
 
     def _accept(self, deadline: float) -> Tuple[socket.socket, Any]:
         self._listener.settimeout(max(deadline - time.monotonic(), 0.1))
@@ -164,32 +312,189 @@ class TcpTransport(Transport):
         except socket.timeout:
             raise ConnectionError("timed out waiting for peer connections")
 
+    def _accept_loop(self) -> None:
+        """Persistent re-handshake service (reconnect mode): any peer —
+        resumed socket or restarted process — can dial in and replace
+        its connection at any time."""
+        self._listener.settimeout(0.5)
+        while not self._closed:
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            try:
+                # Bounded handshake: a connector that never sends its
+                # header must not wedge the (single) accept loop.
+                conn.settimeout(2.0)
+                got = self._handshake_accept(conn)
+                conn.settimeout(None)
+            except OSError:
+                conn.close()
+                continue
+            if got is None:
+                conn.close()
+                continue
+            self._install_socket(got[0], conn, got[1], got[2])
+
     def _spawn(self, fn, *args) -> None:
         t = threading.Thread(target=fn, args=args, daemon=True)
         t.start()
-        self._threads.append(t)
+        with self._lock:
+            # Prune finished threads (under the lock — concurrent spawns
+            # rebuilding the list lock-free could drop each other's
+            # entries) so a flapping link cannot grow it without bound.
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
 
-    def _reader(self, peer: int, conn: socket.socket) -> None:
+    def _current_gen(self, peer: int) -> int:
+        with self._lock:
+            return self._gen[peer]
+
+    def _on_disconnect(self, peer: int, gen: int) -> None:
+        """Reader/writer generation ``gen`` observed the connection die.
+        Without reconnect: fail loudly now.  With reconnect: the dialing
+        side redials; both sides arm a watchdog that falls back to the
+        fail-loud path if no replacement arrives in the window."""
+        if self._closed or self._current_gen(peer) != gen:
+            return  # stale generation or shutdown
+        with self._lock:
+            # Reader and writer both observe the same death; recover once.
+            if (peer, gen) in self._disconnect_seen:
+                return
+            self._disconnect_seen = {
+                (p, g) for (p, g) in self._disconnect_seen if p != peer
+            }
+            self._disconnect_seen.add((peer, gen))
+        if self.reconnect <= 0:
+            self._fail_unmatched_recvs(peer)
+            self._drain_outbox(
+                peer, error=f"send to rank {peer} failed: connection lost"
+            )
+            return
+        if peer < self.rank:
+            self._spawn(self._redial, peer, gen)
+        self._spawn(self._reconnect_watchdog, peer, gen)
+
+    def _redial(self, peer: int, gen: int) -> None:
+        deadline = time.monotonic() + self.reconnect
+        backoff = 0.05
+        while (not self._closed and self._current_gen(peer) == gen
+               and time.monotonic() < deadline):
+            try:
+                conn, pnonce, peer_last = self._dial(
+                    self.addresses[peer],
+                    min(time.monotonic() + backoff + 5.0, deadline), peer,
+                )
+            except (OSError, ConnectionError):
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+                continue
+            # expect_gen: refused atomically if the accept loop beat us
+            # or the watchdog already poisoned this generation.
+            self._install_socket(peer, conn, pnonce, peer_last,
+                                 expect_gen=gen)
+            return
+
+    def _reconnect_watchdog(self, peer: int, gen: int) -> None:
+        deadline = time.monotonic() + self.reconnect
+        while time.monotonic() < deadline:
+            if self._closed or self._current_gen(peer) != gen:
+                return  # replaced (or shutting down) — recovery done
+            time.sleep(0.05)
+        with self._lock:
+            if self._closed or self._gen[peer] != gen:
+                return
+            # Poison the generation: a redial racing this expiry cannot
+            # install afterwards (fail everything or recover everything).
+            # A LATER fresh connection through the accept loop may still
+            # revive the peer — the shm transport's late-resurrection
+            # semantics — but never one tied to this failed window.
+            self._gen[peer] += 1
+        self._fail_unmatched_recvs(peer)
+        self._drain_outbox(
+            peer,
+            error=(f"send to rank {peer} failed: connection lost "
+                   f"(no reconnect within {self.reconnect}s)"),
+        )
+
+    def _reader(self, peer: int, conn: socket.socket, gen: int) -> None:
         graceful = False
         try:
             while True:
                 hdr = _recv_exact(conn, _HDR.size)
                 if hdr is None:
                     return
-                tag, size = _HDR.unpack(hdr)
+                tag, size, seq = _HDR.unpack(hdr)
                 if tag == _GOODBYE_TAG:
                     graceful = True  # peer is closing in an orderly way
                     return
+                if tag == _ACK_TAG:
+                    # Delivery confirmation: release every retained frame
+                    # up to the acked sequence.
+                    self._process_ack(peer, seq)
+                    continue
                 payload = _recv_exact(conn, int(size)) if size else b""
                 if payload is None:
                     return
                 with self._lock:
-                    self._channels[(peer, int(tag))].msgs.append(payload)
+                    if seq > self._last_seq[peer]:
+                        self._last_seq[peer] = seq
+                        self._channels[(peer, int(tag))].msgs.append(payload)
+                    # else: duplicate from a reconnect resend — drop it,
+                    # but still re-ack (the original ack may be exactly
+                    # what the tear swallowed).
+                    ack_val = self._last_seq[peer]
+                if self.reconnect > 0:
+                    self._enqueue_ack(peer, ack_val)
         except OSError:
-            return  # socket torn down by close()
+            return  # socket torn down by close() or connection loss
         finally:
-            if not graceful and not self._closed:
-                self._fail_unmatched_recvs(peer)
+            if graceful:
+                # The peer is gone by protocol: frames retained for acks
+                # can never be released — settle them silently (the
+                # done-or-cancelled contract; same as close()'s drain).
+                cv = self._out_cv[peer]
+                with cv:
+                    ua = self._unacked[peer]
+                    while ua:
+                        h = ua.popleft()[0]
+                        h.cancelled = True
+                        h.buf = None
+                return
+            if self._closed:
+                return
+            self._on_disconnect(peer, gen)
+
+    def _process_ack(self, peer: int, acked: int) -> None:
+        cv = self._out_cv[peer]
+        done = []
+        with cv:
+            ua = self._unacked[peer]
+            while ua and ua[0][3] is not None and ua[0][3] <= acked:
+                done.append(ua.popleft()[0])
+        for h in done:
+            h.done = True
+            h.buf = None
+
+    def _enqueue_ack(self, peer: int, acked: int) -> None:
+        cv = self._out_cv[peer]
+        with cv:
+            if peer in self._dead_peers or self._closed:
+                return
+            pending = self._pending_ack.get(peer)
+            if pending is not None:
+                # Acks are cumulative: overwrite the still-queued ack's
+                # horizon instead of queueing another (a gradient storm
+                # would otherwise double the writer's syscall count).
+                pending[1] = _HDR.pack(_ACK_TAG, 0, acked)
+                return
+            entry = [Handle(kind="send", peer=peer, tag=_ACK_TAG),
+                     _HDR.pack(_ACK_TAG, 0, acked), _EMPTY, None]
+            self._pending_ack[peer] = entry
+            self._outboxes[peer].append(entry)
+            cv.notify()
 
     def _fail_unmatched_recvs(self, peer: int) -> None:
         """A mid-run reader death (peer crashed / link dropped): every
@@ -209,21 +514,34 @@ class TcpTransport(Transport):
                     h.cancelled = True
                     h.meta["error"] = err
 
-    def _writer(self, peer: int, conn: socket.socket) -> None:
+    def _writer(self, peer: int, conn: socket.socket, gen: int) -> None:
         cv = self._out_cv[peer]
         box = self._outboxes[peer]
         while True:
             with cv:
-                while not box and not self._closed:
+                while (not box and not self._closed
+                       and self._gen[peer] == gen):
                     cv.wait(0.5)
+                if self._gen[peer] != gen:
+                    return  # superseded: the replacement writer owns the box
                 if self._closed and not box:
                     return
-                handle, header, payload = box.popleft()
+                if not box:
+                    continue
+                # PEEK, don't pop: the frame stays queued until fully
+                # written, so a reconnect's replacement writer resends it
+                # whole (the receiver dedups by sequence number).
+                entry = box[0]
+                handle, header, payload, retain_seq = entry
             try:
                 conn.sendall(header)
                 if payload.nbytes:
                     conn.sendall(payload)
             except OSError:
+                if self.reconnect > 0 and not self._closed:
+                    # Leave the frame at the head for the successor.
+                    self._on_disconnect(peer, gen)
+                    return
                 # Dead peer/socket: cancel this and every queued send with
                 # a recorded error so blocking senders get a raise from
                 # test() (the shm transport's raise-once convention)
@@ -234,8 +552,26 @@ class TcpTransport(Transport):
                 handle.meta["error"] = err
                 self._drain_outbox(peer, error=err)
                 return
-            handle.done = True
-            handle.buf = None  # ownership back to the caller
+            popped = retained = False
+            with cv:
+                # Only settle the entry if it is still ours to settle: a
+                # reconnect's settle may have already reshuffled the box
+                # while we were in sendall — then the successor owns it,
+                # and retaining here would corrupt _unacked's ordering.
+                if box and box[0] is entry:
+                    box.popleft()
+                    popped = True
+                    if retain_seq is not None and self.reconnect > 0:
+                        # Delivered to the kernel is NOT delivered to
+                        # the peer: retain until the peer's ack (or the
+                        # reconnect-handshake horizon) releases it.
+                        self._unacked[peer].append(entry)
+                        retained = True
+                    if entry is self._pending_ack.get(peer):
+                        self._pending_ack[peer] = None
+            if popped and not retained:
+                handle.done = True
+                handle.buf = None  # ownership back to the caller
 
     def _drain_outbox(self, peer: int, error: str | None = None) -> None:
         """Cancel every queued send to ``peer``.  With ``error`` (dead
@@ -245,12 +581,13 @@ class TcpTransport(Transport):
         with cv:
             self._dead_peers.add(peer)
             cv.notify_all()
-            while self._outboxes[peer]:
-                h, _hdr, _payload = self._outboxes[peer].popleft()
-                h.cancelled = True
-                h.buf = None
-                if error:
-                    h.meta["error"] = error
+            for q in (self._unacked[peer], self._outboxes[peer]):
+                while q:
+                    h = q.popleft()[0]
+                    h.cancelled = True
+                    h.buf = None
+                    if error:
+                        h.meta["error"] = error
 
     # -- Transport -----------------------------------------------------------
 
@@ -273,8 +610,10 @@ class TcpTransport(Transport):
                 handle.buf = None
                 handle.meta["error"] = f"rank {dst} unreachable (writer dead)"
                 return handle
+            self._send_seq[dst] += 1
             self._outboxes[dst].append(
-                (handle, _HDR.pack(tag, view.nbytes), view)
+                (handle, _HDR.pack(tag, view.nbytes, self._send_seq[dst]),
+                 view, self._send_seq[dst])
             )
             cv.notify()
         return handle
@@ -373,7 +712,7 @@ class TcpTransport(Transport):
                 if peer not in self._dead_peers:
                     self._outboxes[peer].append(
                         (Handle(kind="send", peer=peer, tag=_GOODBYE_TAG),
-                         _HDR.pack(_GOODBYE_TAG, 0), zero.view())
+                         _HDR.pack(_GOODBYE_TAG, 0, 0), zero.view(), None)
                     )
                     cv.notify()
         deadline = time.monotonic() + 1.0

@@ -298,11 +298,16 @@ class BiCNNTrainer:
 
     def _load_data(self) -> QAData:
         cfg = self.cfg
+        file_keys = ("embedding_file", "train_file", "valid_file",
+                     "test_file1", "test_file2", "label2answ_file")
+        explicit_files = all(cfg.get(k, "none") != "none" for k in file_keys)
         # Effective embedding width, resolved ONCE so every branch
         # (binary cache validation included) agrees: docqa's 50-dim
-        # files override an untouched 100-dim config default.
+        # files override an untouched 100-dim config default — but only
+        # when the docqa branch would actually load the data (explicit
+        # --*_file flags take precedence over the fixture).
         want_dim = cfg.embedding_dim
-        if (cfg.get("docqa", False)
+        if (cfg.get("docqa", False) and not explicit_files
                 and cfg.embedding_dim == BICNN_DEFAULTS.embedding_dim):
             from mpit_tpu.data.qa import DOCQA_EMBEDDING_DIM
 
@@ -316,9 +321,7 @@ class BiCNNTrainer:
                 conv_width=cfg.cont_conv_width,
                 embedding_dim=want_dim,
             )
-        file_keys = ("embedding_file", "train_file", "valid_file",
-                     "test_file1", "test_file2", "label2answ_file")
-        if all(cfg.get(k, "none") != "none" for k in file_keys):
+        if explicit_files:
             data = load_qa(
                 embedding_dim=cfg.embedding_dim,
                 conv_width=cfg.cont_conv_width,

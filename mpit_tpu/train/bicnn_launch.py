@@ -223,15 +223,20 @@ def main(argv: Optional[List[str]] = None) -> None:
             f"unknown optimization {cfg.optimization!r}; "
             f"have {BiCNNTrainer.KNOWN_OPTS}"
         )
-    if cfg.get("docqa", False):
+    file_keys = ("embedding_file", "train_file", "valid_file",
+                 "test_file1", "test_file2", "label2answ_file")
+    if (cfg.get("docqa", False)
+            and not all(cfg.get(k, "none") != "none" for k in file_keys)):
+        # Explicit --*_file flags take precedence over the fixture (the
+        # trainer's _load_data order), so only the fixture-needing case
+        # is validated here — in the parent, so a gang is never spawned
+        # to fail rank by rank.
         from mpit_tpu.data.qa import docqa_paths
 
         if docqa_paths() is None:
             raise FileNotFoundError(
                 "--docqa 1 but data/fixtures/docqa is absent — run "
-                "tools/make_docqa.py or pass explicit --*_file flags "
-                "(checked in the parent so a gang is never spawned "
-                "to fail rank by rank)"
+                "tools/make_docqa.py or pass explicit --*_file flags"
             )
     effective = min(int(cfg.np), int(cfg.maxrank) + 1)
     tester_flags = resolve_tester_flags(cfg)  # validate even for np=1
