@@ -55,6 +55,13 @@ _EMPTY = memoryview(b"")
 # from a crash (fail-loud semantics).  User tags are non-negative
 # (ps/tags.py, collectives' 2^16+ range), so the sentinel can't collide.
 _GOODBYE_TAG = -(1 << 62)
+
+
+class MeshMismatchError(ConnectionError):
+    """The peer answered the handshake with a different address-book /
+    reconnect-mode digest: it belongs to another mesh (or the two sides
+    disagree on reconnect mode, which would deadlock ack-based sends).
+    Raised immediately — never retried."""
 # Reserved wire tag for delivery acknowledgements (reconnect mode): the
 # header's seq field carries the highest data sequence received; no
 # payload.  Acks are neither retained nor themselves acked — a lost ack
@@ -134,9 +141,15 @@ class TcpTransport(Transport):
         self._nonce = secrets.randbits(62)
         import hashlib
 
+        # The digest covers the address book AND the reconnect mode: a
+        # reconnect>0 sender retains frames until acked, so a mixed-mode
+        # pairing (one side acking, one not) would deadlock sends — make
+        # it a connect-time refusal instead.
         self._book_hash = int.from_bytes(
-            hashlib.blake2b(",".join(self.addresses).encode(),
-                            digest_size=7).digest(), "little")
+            hashlib.blake2b(
+                (",".join(self.addresses)
+                 + f"|reconnect={'on' if self.reconnect > 0 else 'off'}"
+                 ).encode(), digest_size=7).digest(), "little")
         self._lock = threading.Lock()
         self._channels: Dict[Tuple[int, int], _Channel] = defaultdict(_Channel)
         self._peers: Dict[int, socket.socket] = {}
@@ -150,6 +163,11 @@ class TcpTransport(Transport):
         # a reconnect, released (handle.done) by acks.
         self._unacked: Dict[int, deque] = {r: deque() for r in range(nranks)}
         self._pending_ack: Dict[int, Any] = {}
+        # Highest seq each peer has acked — consulted when retaining a
+        # just-sent frame: the ack can RACE the retention (arrive between
+        # sendall returning and the cv re-acquire), and a frame retained
+        # after its own ack would wait forever.
+        self._acked_high: Dict[int, int] = {r: 0 for r in range(nranks)}
         self._out_cv: Dict[int, threading.Condition] = {
             r: threading.Condition() for r in range(nranks)
         }
@@ -227,8 +245,13 @@ class TcpTransport(Transport):
                 _prank, pnonce, peer_last, book = _RANK_HDR.unpack(reply)
                 if book != self._book_hash:
                     conn.close()
-                    raise ConnectionError("peer belongs to a different mesh")
+                    raise MeshMismatchError(
+                        "peer handshake digest mismatch: different mesh "
+                        "or mismatched reconnect mode"
+                    )
                 return conn, int(pnonce), int(peer_last)
+            except MeshMismatchError:
+                raise  # misconfiguration — retrying cannot fix it
             except OSError as e:  # peer not up yet
                 last_err = e
                 time.sleep(0.05)
@@ -268,6 +291,9 @@ class TcpTransport(Transport):
                 conn.close()
                 return False
             old = self._peers.get(peer)
+            nonce_reset = (pnonce is not None
+                           and self._peer_nonce.get(peer) is not None
+                           and self._peer_nonce.get(peer) != pnonce)
             if pnonce is not None and self._peer_nonce.get(peer) != pnonce:
                 # A RESTARTED peer (fresh process, fresh sequence space),
                 # not a resumed connection: reset the dedup horizon.
@@ -279,6 +305,16 @@ class TcpTransport(Transport):
             self._dead_readers.discard(peer)
         done_handles = []
         with cv:
+            if nonce_reset:
+                # Acks already queued for the DEAD instance carry
+                # horizons from its sequence space; delivered to the
+                # replacement they would release (and un-retain) its
+                # entire early window.  Purge them.
+                kept = [e for e in self._outboxes[peer]
+                        if e[0].tag != _ACK_TAG]
+                self._outboxes[peer].clear()
+                self._outboxes[peer].extend(kept)
+                self._pending_ack[peer] = None
             # Settle the unacked window: frames the peer already holds
             # (seq <= its reported horizon) are delivered; the rest go
             # back to the FRONT of the outbox, in order, for resend.
@@ -387,6 +423,8 @@ class TcpTransport(Transport):
                     self.addresses[peer],
                     min(time.monotonic() + backoff + 5.0, deadline), peer,
                 )
+            except MeshMismatchError:
+                return  # foreign mesh on a reassigned port: stop redialing
             except (OSError, ConnectionError):
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 1.0)
@@ -432,13 +470,20 @@ class TcpTransport(Transport):
                     return
                 if tag == _ACK_TAG:
                     # Delivery confirmation: release every retained frame
-                    # up to the acked sequence.
-                    self._process_ack(peer, seq)
+                    # up to the acked sequence.  (Stale-generation acks
+                    # are ignored — _process_ack checks.)
+                    self._process_ack(peer, seq, gen)
                     continue
                 payload = _recv_exact(conn, int(size)) if size else b""
                 if payload is None:
                     return
                 with self._lock:
+                    if self._gen[peer] != gen:
+                        # Superseded connection (e.g. the peer restarted
+                        # and the dedup horizon was reset): frames still
+                        # draining from the old socket's kernel buffer
+                        # must not advance state in the new seq space.
+                        return
                     if seq > self._last_seq[peer]:
                         self._last_seq[peer] = seq
                         self._channels[(peer, int(tag))].msgs.append(payload)
@@ -467,10 +512,15 @@ class TcpTransport(Transport):
                 return
             self._on_disconnect(peer, gen)
 
-    def _process_ack(self, peer: int, acked: int) -> None:
+    def _process_ack(self, peer: int, acked: int, gen: int) -> None:
         cv = self._out_cv[peer]
         done = []
         with cv:
+            with self._lock:
+                if self._gen[peer] != gen:
+                    return  # ack from a superseded connection
+            if acked > self._acked_high[peer]:
+                self._acked_high[peer] = acked
             ua = self._unacked[peer]
             while ua and ua[0][3] is not None and ua[0][3] <= acked:
                 done.append(ua.popleft()[0])
@@ -532,6 +582,12 @@ class TcpTransport(Transport):
                 # written, so a reconnect's replacement writer resends it
                 # whole (the receiver dedups by sequence number).
                 entry = box[0]
+                if entry is self._pending_ack.get(peer):
+                    # Detach from coalescing NOW, under the cv: the
+                    # header bytes are captured on the next line, and a
+                    # reader overwriting the horizon after that would be
+                    # silently lost — the sender it acks would deadlock.
+                    self._pending_ack[peer] = None
                 handle, header, payload, retain_seq = entry
             try:
                 conn.sendall(header)
@@ -554,6 +610,13 @@ class TcpTransport(Transport):
                 return
             popped = retained = False
             with cv:
+                with self._lock:
+                    if self._gen[peer] != gen:
+                        # A reconnect installed while we were in sendall:
+                        # whatever we wrote went to a dead socket, and
+                        # the successor's settle owns the box — touching
+                        # it (or _unacked) here would strand the frame.
+                        return
                 # Only settle the entry if it is still ours to settle: a
                 # reconnect's settle may have already reshuffled the box
                 # while we were in sendall — then the successor owns it,
@@ -561,14 +624,15 @@ class TcpTransport(Transport):
                 if box and box[0] is entry:
                     box.popleft()
                     popped = True
-                    if retain_seq is not None and self.reconnect > 0:
+                    if (retain_seq is not None and self.reconnect > 0
+                            and retain_seq > self._acked_high[peer]):
                         # Delivered to the kernel is NOT delivered to
                         # the peer: retain until the peer's ack (or the
-                        # reconnect-handshake horizon) releases it.
+                        # reconnect-handshake horizon) releases it.  (A
+                        # frame whose ack already landed — the ack can
+                        # race this retention — completes right away.)
                         self._unacked[peer].append(entry)
                         retained = True
-                    if entry is self._pending_ack.get(peer):
-                        self._pending_ack[peer] = None
             if popped and not retained:
                 handle.done = True
                 handle.buf = None  # ownership back to the caller

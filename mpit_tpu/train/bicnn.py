@@ -54,6 +54,18 @@ from mpit_tpu.utils.timers import PhaseTimers
 
 # The full plaunch.lua flag surface (reference BiCNN/plaunch.lua:7-69),
 # snake_cased; rebuild-only knobs at the bottom.
+QA_FILE_KEYS = ("embedding_file", "train_file", "valid_file",
+                "test_file1", "test_file2", "label2answ_file")
+
+
+def explicit_qa_files(cfg) -> bool:
+    """True when ALL six corpus files are given explicitly — the ONE
+    predicate deciding whether file flags take precedence over the
+    docqa fixture (shared by the trainer's _load_data and the launcher's
+    parent-side validation, which must agree)."""
+    return all(cfg.get(k, "none") != "none" for k in QA_FILE_KEYS)
+
+
 BICNN_DEFAULTS = Config(
     optimization="downpour",  # sgd|downpour|eamsgd|adam|adamax|adamsingle|
     #   adamaxsingle|rmsprop|rmspropsingle|adagrad|adagradsingle|adadelta|
@@ -298,9 +310,7 @@ class BiCNNTrainer:
 
     def _load_data(self) -> QAData:
         cfg = self.cfg
-        file_keys = ("embedding_file", "train_file", "valid_file",
-                     "test_file1", "test_file2", "label2answ_file")
-        explicit_files = all(cfg.get(k, "none") != "none" for k in file_keys)
+        explicit_files = explicit_qa_files(cfg)
         # Effective embedding width, resolved ONCE so every branch
         # (binary cache validation included) agrees: docqa's 50-dim
         # files override an untouched 100-dim config default — but only

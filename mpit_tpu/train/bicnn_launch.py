@@ -223,10 +223,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             f"unknown optimization {cfg.optimization!r}; "
             f"have {BiCNNTrainer.KNOWN_OPTS}"
         )
-    file_keys = ("embedding_file", "train_file", "valid_file",
-                 "test_file1", "test_file2", "label2answ_file")
-    if (cfg.get("docqa", False)
-            and not all(cfg.get(k, "none") != "none" for k in file_keys)):
+    from mpit_tpu.train.bicnn import explicit_qa_files
+
+    if cfg.get("docqa", False) and not explicit_qa_files(cfg):
         # Explicit --*_file flags take precedence over the fixture (the
         # trainer's _load_data order), so only the fixture-needing case
         # is validated here — in the parent, so a gang is never spawned
