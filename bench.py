@@ -179,7 +179,42 @@ def bench_torch_cpu() -> float:
     return sps
 
 
+def _device_responsive(timeout_s: float = 240.0) -> bool:
+    """Probe the accelerator in a SUBPROCESS with a hard timeout: the
+    axon tunnel has been observed to wedge outright (a cached trivial
+    jit never returns), and a hung bench leaves the driver with no
+    record at all — an explicit failure line beats silence."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "print(float(jax.jit(lambda a: (a @ a).sum())"
+        "(jnp.ones((256, 256)))))"
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False
+    if r.returncode != 0:
+        # A fast crash is NOT a hang: surface the real traceback and
+        # let the bench proceed to fail with it rather than fabricating
+        # a tunnel-outage diagnosis.
+        _log("device probe crashed (not a hang):")
+        _log(r.stderr.decode(errors="replace")[-2000:])
+    return True
+
+
 def main():
+    if not _device_responsive():
+        _log("device probe timed out: accelerator/tunnel unresponsive")
+        print(json.dumps({
+            "metric": "mnist_easgd_train_samples_per_sec",
+            "value": None, "unit": "samples/s", "vs_baseline": None,
+            "error": "device unresponsive: a trivial jitted matmul did "
+                     "not complete within 240s (tunnel outage)",
+        }))
+        sys.exit(1)
     trains = []
     for rep in range(REPS):
         _log(f"-- train rep {rep + 1}/{REPS} --")

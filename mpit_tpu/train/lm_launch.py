@@ -268,6 +268,25 @@ def run(cfg: Config) -> dict:
     rows = (process_local_rows(batch_sharding, cfg.batch)
             if pg.num_processes > 1 else slice(None))
 
+    # Compile + warm the step program before t0 (mesh_launch's
+    # precompile discipline): the jits donate w/vt, so copies run
+    # through them and are discarded — tokens_per_sec measures training,
+    # not XLA, and compile_s is reported separately.
+    t_c = time.perf_counter()
+    warm_tokens = put_local(
+        jnp.zeros((cfg.batch, cfg.seq_len + 1), jnp.int32)[rows],
+        batch_sharding)
+    warm_out = train_step(jnp.copy(w), jnp.copy(vt), jnp.copy(k_step),
+                          warm_tokens)
+    # Host fetch fences the warm execution (block_until_ready lies on
+    # tunneled platforms, utils/timing.py) — without it compile_s stops
+    # early and the warm step bleeds into the timed region.
+    from mpit_tpu.utils.timing import fetch_scalar
+
+    fetch_scalar(warm_out[-1])
+    compile_s = time.perf_counter() - t_c
+    log.info("precompile: %.2fs", compile_s)
+
     losses: List = []
     history: List[dict] = []
     t0 = time.perf_counter()
@@ -315,6 +334,7 @@ def run(cfg: Config) -> dict:
         "elapsed": round(elapsed, 3),
         "tokens_trained": trained,
         "tokens_per_sec": round(trained / max(elapsed - prev_elapsed, 1e-9), 1),
+        "compile_s": round(compile_s, 3),
         "mesh": {"dp": dp, "sp": sp},
         "params": flat.size,
         "processes": pg.num_processes,
