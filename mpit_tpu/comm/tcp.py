@@ -492,7 +492,7 @@ class TcpTransport(Transport):
                     # what the tear swallowed).
                     ack_val = self._last_seq[peer]
                 if self.reconnect > 0:
-                    self._enqueue_ack(peer, ack_val)
+                    self._enqueue_ack(peer, ack_val, gen)
         except OSError:
             return  # socket torn down by close() or connection loss
         finally:
@@ -528,11 +528,22 @@ class TcpTransport(Transport):
             h.done = True
             h.buf = None
 
-    def _enqueue_ack(self, peer: int, acked: int) -> None:
+    def _enqueue_ack(self, peer: int, acked: int, gen: int) -> None:
         cv = self._out_cv[peer]
         with cv:
             if peer in self._dead_peers or self._closed:
                 return
+            with self._lock:
+                if self._gen[peer] != gen:
+                    # A replacement connection installed between the
+                    # reader's gen check and this enqueue.  If the peer
+                    # RESTARTED, ``acked`` is a horizon from the dead
+                    # instance's sequence space — queued onto the new
+                    # connection it would release the restarted peer's
+                    # entire unacked window (silent loss under the
+                    # exactly-once contract).  Drop it; the new reader
+                    # generation acks its own deliveries.
+                    return
             pending = self._pending_ack.get(peer)
             if pending is not None:
                 # Acks are cumulative: overwrite the still-queued ack's

@@ -335,7 +335,7 @@ class BiCNNTrainer:
             data = load_qa(
                 embedding_dim=cfg.embedding_dim,
                 conv_width=cfg.cont_conv_width,
-                paths={k: pathlib.Path(cfg.get(k)) for k in file_keys},
+                paths={k: pathlib.Path(cfg.get(k)) for k in QA_FILE_KEYS},
                 oov_seed=cfg.seed,
             )
         elif cfg.get("docqa", False):

@@ -87,12 +87,27 @@ def enable_compile_cache(path: str | None = None) -> str:
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    # LRU eviction cap: with the thresholds dropped, every compiled
-    # program lands in the cache (the test suite alone writes hundreds
-    # of tiny CPU executables per run) and jax never evicts by default.
-    jax.config.update("jax_compilation_cache_max_size",
-                      int(os.environ.get("MPIT_COMPILE_CACHE_MAX",
-                                         str(2 << 30))))
+    # Eviction is DISABLED by default (-1).  jax's LRU eviction keeps a
+    # per-entry ``*-atime`` sentinel and, on every put, stats the whole
+    # directory — any entry written by a process that ran with eviction
+    # off (jax's own default) has no sentinel, which makes every
+    # subsequent eviction-enabled put fail with a FileNotFoundError
+    # warning; concurrent writers (gang children, pytest) race the same
+    # way.  Measured growth is ~7 MB/round, so an unbounded cache is the
+    # cheaper contract.  Set ``MPIT_COMPILE_CACHE_MAX`` (bytes) to opt
+    # back into a cap; missing sentinels are healed first so the put
+    # path cannot warn about pre-existing orphans.
+    max_size = int(os.environ.get("MPIT_COMPILE_CACHE_MAX", "-1"))
+    jax.config.update("jax_compilation_cache_max_size", max_size)
+    if max_size != -1:
+        import time
+
+        stamp = time.time_ns().to_bytes(8, "little")
+        for entry in pathlib.Path(cache).glob("*-cache"):
+            sentinel = entry.with_name(
+                entry.name.removesuffix("-cache") + "-atime")
+            if not sentinel.exists():
+                sentinel.write_bytes(stamp)
     return cache
 
 

@@ -180,6 +180,19 @@ class TestLocalTraining:
             tr1.data.train.q_tokens, tr2.data.train.q_tokens
         )
 
+    def test_explicit_file_flags(self, tmp_path):
+        """All six --*_file flags take precedence over fixtures and load
+        through load_qa (the plaunch.lua text-file path, plaunch.lua:45-52)."""
+        paths = qa.synthetic_qa(tmp_path, n_labels=6, n_train=32, n_eval=8,
+                                embedding_dim=6, vocab_words=40, seed=3)
+        cfg = BICNN_DEFAULTS.merged(TINY).merged(
+            optimization="sgd",
+            **{k: str(p) for k, p in paths.items()},
+        )
+        tr = BiCNNTrainer(cfg)
+        assert len(tr.data.train) == 32
+        assert tr.data.vocab.embedding_dim == 6
+
     def test_single_process_rejects_distributed_opt(self, data):
         cfg = BICNN_LAUNCH_DEFAULTS.merged(TINY).merged(
             np=1, optimization="adamsingle", valid_mode="none",

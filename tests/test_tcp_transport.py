@@ -468,6 +468,31 @@ class TestReconnect:
             if b2 is not None:
                 b2.close()
 
+    def test_stale_generation_ack_is_dropped(self):
+        """An ack enqueued by a reader of a superseded connection must not
+        reach the outbox: after a restarted peer installs (nonce reset
+        purges queued acks), a stale ack carrying the dead instance's
+        sequence horizon would release the replacement's unacked window."""
+        _addrs, _socks, (a, b) = self._mesh(2, reconnect=15.0)
+        try:
+            a.send(b"warm", 1, 2)
+            assert b.recv(0, 2) == b"warm"
+            with b._lock:
+                old_gen = b._gen[0]
+                b._gen[0] += 1  # simulate a replacement install winning
+            with b._out_cv[0]:
+                b._pending_ack[0] = None
+                b._outboxes[0].clear()
+            b._enqueue_ack(0, 10**9, old_gen)  # the racing reader's enqueue
+            with b._out_cv[0]:
+                assert b._pending_ack.get(0) is None
+                assert not b._outboxes[0]
+            with b._lock:
+                b._gen[0] = old_gen  # restore so close() is orderly
+        finally:
+            a.close()
+            b.close()
+
     def test_window_expiry_falls_back_to_fail_loud(self):
         _addrs, _socks, (a, b) = self._mesh(2, reconnect=0.3)
         try:
