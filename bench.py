@@ -205,14 +205,37 @@ def _device_responsive(timeout_s: float = 240.0) -> bool:
     return True
 
 
+def _probe_retries() -> int:
+    return max(int(os.environ.get("MPIT_BENCH_PROBE_RETRIES", "3")), 1)
+
+
+def _device_responsive_with_retry() -> bool:
+    """Bounded probe-retry: tunnel outages are often transient (observed
+    wedges clear within minutes to hours), and a single failed probe
+    erased round 4's entire evidence record — so retry a few times over
+    ~15 min before giving up (MPIT_BENCH_PROBE_RETRIES=1 restores the
+    single-shot behavior for interactive runs)."""
+    retries = _probe_retries()
+    wait_s = float(os.environ.get("MPIT_BENCH_PROBE_WAIT", "420"))
+    for attempt in range(1, retries + 1):
+        if _device_responsive():
+            return True
+        _log(f"device probe {attempt}/{retries} timed out: "
+             "accelerator/tunnel unresponsive")
+        if attempt < retries:
+            _log(f"retrying in {wait_s:.0f}s")
+            time.sleep(wait_s)
+    return False
+
+
 def main():
-    if not _device_responsive():
-        _log("device probe timed out: accelerator/tunnel unresponsive")
+    if not _device_responsive_with_retry():
         print(json.dumps({
             "metric": "mnist_easgd_train_samples_per_sec",
             "value": None, "unit": "samples/s", "vs_baseline": None,
-            "error": "device unresponsive: a trivial jitted matmul did "
-                     "not complete within 240s (tunnel outage)",
+            "error": "device unresponsive: a trivial jitted matmul never "
+                     "completed within a 240s probe (tunnel outage; "
+                     f"probed {_probe_retries()} times before giving up)",
         }))
         sys.exit(1)
     trains = []
@@ -259,6 +282,9 @@ def main():
         "time_to_target_runs": [round(v, 3) for v in ttt_runs],
         "compile_s": round(_median(compile_runs), 3) if compile_runs else None,
         "target_test_err": train["target_test_err"],
+        "measurement_condition": "BASELINE.md §'Measurement condition in "
+        "THIS environment' (optdigits-8x8 fixture, 2% target; no-egress "
+        "environment, real MNIST unavailable)",
         "final_test_err": train["final_test_err"],
         "epochs_run": len(train["history"]),
         "data_source": train["data_source"],

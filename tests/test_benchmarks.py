@@ -71,8 +71,8 @@ def test_testreduceall_shm_mode():
     shm transport (the literal test/testreduceall.lua shape)."""
     (r,) = run_bench(
         "testreduceall.py",
-        {"MEGS": "1", "MPIT_BENCH_MODE": "shm", "MPIT_BENCH_RANKS": "3"},
+        {"MEGS": "1", "MPIT_BENCH_MODE": "shm", "MPIT_BENCH_RANKS": "2"},
     )
     assert r["metric"] == "host_allreduce_bandwidth_shm"
-    assert r["value"] > 0 and r["ranks"] == 3
+    assert r["value"] > 0 and r["ranks"] == 2
     assert r["ms_per_round"] > 0

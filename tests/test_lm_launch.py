@@ -23,7 +23,7 @@ def _cfg(**kw):
 
 
 def test_learns_on_synthetic_bytes():
-    res = run(_cfg(steps=60, lr=3e-3, dp=2, sp=4))
+    res = run(_cfg(steps=40, lr=3e-3, dp=2, sp=4))
     losses = [h["avg_loss"] for h in res["history"]]
     assert all(np.isfinite(x) for x in losses)
     assert losses[-1] < losses[0] - 0.05, losses
@@ -73,7 +73,7 @@ def test_init_with_dp_not_dividing_local_rows():
 
 
 def test_resume_batch_mismatch_raises(tmp_path):
-    run(_cfg(steps=4, lr=1e-3, dp=2, sp=4, log_every=2,
+    run(_cfg(steps=2, lr=1e-3, dp=2, sp=4, log_every=2,
              ckpt_dir=str(tmp_path), ckpt_every=2))
     with pytest.raises(ValueError, match="batch"):
         run(_cfg(steps=8, lr=1e-3, dp=2, sp=4, batch=16, log_every=2,

@@ -150,7 +150,22 @@ def test_flash_offsets_pallas(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(full[C:]), atol=2e-5)
 
 
-def test_flash_grad_matches_reference(rng):
+@pytest.fixture
+def fa_backward_path(request, monkeypatch):
+    """Pin the backward schedule (fused vs two-kernel) for one test.
+
+    The MPIT_FA_FUSED_BWD gate is read at trace time, so a cached trace
+    from the other leg would silently shadow the pinned one — clear
+    jax's trace/compile caches around the leg (cheap at these shapes)."""
+    monkeypatch.setenv("MPIT_FA_FUSED_BWD", request.param)
+    jax.clear_caches()
+    yield request.param
+    jax.clear_caches()
+
+
+@pytest.mark.parametrize("fa_backward_path", ["1", "0"], indirect=True,
+                         ids=["fused-bwd", "two-kernel-bwd"])
+def test_flash_grad_matches_reference(rng, fa_backward_path):
     q, k, v = _qkv(rng, (24, 16))
 
     def loss_flash(q, k, v):
@@ -167,6 +182,29 @@ def test_flash_grad_matches_reference(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
+def test_flash_dimsem_off_smoke(rng, monkeypatch):
+    """MPIT_FA_DIMSEM=0 (unannotated grids, the other A/B lever) still
+    produces correct forward and gradients."""
+    monkeypatch.setenv("MPIT_FA_DIMSEM", "0")
+    jax.clear_caches()
+    try:
+        q, k, v = _qkv(rng, (24, 16))
+        fa = lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=8, block_k=128) ** 2
+        )
+        ref = lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal=True) ** 2
+        )
+        np.testing.assert_allclose(
+            float(fa(q, k, v)), float(ref(q, k, v)), rtol=1e-5
+        )
+        for a, b in zip(jax.grad(fa, argnums=(0, 1, 2))(q, k, v),
+                        jax.grad(ref, argnums=(0, 1, 2))(q, k, v)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+    finally:
+        jax.clear_caches()
+
+
 def test_flash_ragged_lengths(rng):
     """Non-block-multiple Lq/Lk/D are padded and masked correctly."""
     q, k, v = _qkv(rng, (19, 12))
@@ -176,7 +214,9 @@ def test_flash_ragged_lengths(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_flash_bwd_ragged_offset_pair(rng):
+@pytest.mark.parametrize("fa_backward_path", ["1", "0"], indirect=True,
+                         ids=["fused-bwd", "two-kernel-bwd"])
+def test_flash_bwd_ragged_offset_pair(rng, fa_backward_path):
     """The pallas backward handles the ring's per-step shape: unequal
     ragged Lq/Lk, global offsets, batched leading axes."""
     q = _qkv(rng, (2, 19, 12))[0]
