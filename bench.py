@@ -89,7 +89,9 @@ def _torch_threads() -> int:
 
 def bench_train() -> dict:
     """Flagship mesh-EASGD run to target test error on the real stream."""
-    from mpit_tpu.train.mesh_launch import MESH_LAUNCH_DEFAULTS, run
+    from mpit_tpu.train.mesh_launch import (
+        FLAGSHIP_BENCH_KWARGS, MESH_LAUNCH_DEFAULTS, run,
+    )
 
     # target_test_err: BASELINE's north star is 1% on real MNIST; this
     # environment has only the sklearn-digits fallback, where the flagship
@@ -98,9 +100,8 @@ def bench_train() -> dict:
     # and the source.
     target = float(os.environ.get("MPIT_BENCH_TARGET", "0.02"))
     cfg = MESH_LAUNCH_DEFAULTS.merged(
-        opt="easgd", model="cnn", epochs=EPOCHS, batch=BATCH, side=SIDE,
-        su=10, mom=0.99, lr=1e-2, target_test_err=target, stop_at_target=1,
-        device_stream=1, measure_throughput=1, precompile=1,
+        **FLAGSHIP_BENCH_KWARGS, epochs=EPOCHS,
+        target_test_err=target, stop_at_target=1, measure_throughput=1,
     )
     result = run(cfg)
     result["target_test_err"] = target
@@ -138,6 +139,11 @@ def bench_torch_cpu() -> float:
     import torch.nn as tnn
 
     from mpit_tpu.data.mnist import load_mnist
+    from mpit_tpu.train.mesh_launch import FLAGSHIP_BENCH_KWARGS
+
+    # The torch leg must mirror the jax leg's workload shape exactly.
+    assert FLAGSHIP_BENCH_KWARGS["batch"] == BATCH
+    assert FLAGSHIP_BENCH_KWARGS["side"] == SIDE
 
     (x_train, y_train, _, _), _src = load_mnist(side=SIDE)
     torch.manual_seed(0)

@@ -20,6 +20,18 @@ def log(*a) -> None:
     print(*a, file=sys.stderr, flush=True)
 
 
+def emit_json(rec: dict, out_path: str = "") -> None:
+    """One JSON line to stdout (the bench contract) + optional append to
+    ``out_path`` — the single copy of the emit-and-record pattern."""
+    import json
+
+    line = json.dumps(rec)
+    print(line)
+    if out_path:
+        with open(out_path, "a") as fh:
+            fh.write(line + "\n")
+
+
 def join_checked(threads, timeout: float, what: str) -> None:
     """Join every thread and fail loudly on a hang — a stalled rank must
     produce an error, not a bogus bandwidth number."""

@@ -100,7 +100,10 @@ def main() -> None:
             else:
                 for line in r.stdout.splitlines():
                     if line.startswith("CHILD_JSON "):
-                        rec = json.loads(line[len("CHILD_JSON "):])
+                        try:
+                            rec = json.loads(line[len("CHILD_JSON "):])
+                        except json.JSONDecodeError:
+                            pass  # truncated line (child killed mid-print)
                 if rec is None:
                     rec = {"mode": mode, "L": L,
                            "error": f"child rc={r.returncode}: "
@@ -115,16 +118,14 @@ def main() -> None:
                     B * H * nj * L * D * 4 / 2**20, 1)
             rows.append(rec)
             _log(f"[bwd-ab] {rec}")
-    line = json.dumps({
+    from _common import emit_json
+
+    emit_json({
         "metric": "flash_bwd_fused_vs_twokernel",
         "shape": {"B": B, "H": H, "D": D, "dtype": "bfloat16",
                   "causal": True},
         "rows": rows,
-    })
-    print(line)
-    if out:
-        with open(out, "a") as fh:
-            fh.write(line + "\n")
+    }, out)
 
 
 if __name__ == "__main__":

@@ -34,7 +34,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _common import log as _log, setup_platform  # noqa: E402
+from _common import emit_json, log as _log, setup_platform  # noqa: E402
 
 setup_platform()
 
@@ -56,11 +56,7 @@ BF16_PEAK_TFLOPS = {
 
 
 def _emit(rec: dict) -> None:
-    line = json.dumps(rec)
-    print(line)
-    if OUT:
-        with open(OUT, "a") as fh:
-            fh.write(line + "\n")
+    emit_json(rec, OUT)
 
 
 def _time(fn, *args, iters=ITERS):

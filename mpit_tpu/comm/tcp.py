@@ -375,7 +375,10 @@ class TcpTransport(Transport):
             self._install_socket(got[0], conn, got[1], got[2])
 
     def _spawn(self, fn, *args) -> None:
-        t = threading.Thread(target=fn, args=args, daemon=True)
+        # Role-named (e.g. "_reader-1"): observable teardown for tests
+        # and thread dumps.
+        name = f"{fn.__name__}-{args[0] if args else ''}"
+        t = threading.Thread(target=fn, args=args, daemon=True, name=name)
         t.start()
         with self._lock:
             # Prune finished threads (under the lock — concurrent spawns

@@ -68,6 +68,15 @@ MESH_LAUNCH_DEFAULTS = Config(
     process_id=-1,
 )
 
+# The flagship benchmark training config (mlaunch.lua:39-47 analog) —
+# ONE definition shared by bench.py (throughput/time-to-target) and
+# tools/accuracy_table.py (3-seed test_err), so the accuracy evidence
+# always describes the benchmarked trainer.
+FLAGSHIP_BENCH_KWARGS = dict(
+    opt="easgd", model="cnn", batch=128, side=32,
+    su=10, mom=0.99, lr=1e-2, device_stream=1, precompile=1,
+)
+
 
 def run(cfg: Config) -> dict:
     # Bootstrap BEFORE any jax backend use (multi-host group formation).

@@ -71,8 +71,10 @@ def test_testreduceall_shm_mode():
     shm transport (the literal test/testreduceall.lua shape)."""
     (r,) = run_bench(
         "testreduceall.py",
-        {"MEGS": "1", "MPIT_BENCH_MODE": "shm", "MPIT_BENCH_RANKS": "2"},
+        {"MEGS": "1", "MPIT_BENCH_MODE": "shm", "MPIT_BENCH_RANKS": "3"},
     )
     assert r["metric"] == "host_allreduce_bandwidth_shm"
-    assert r["value"] > 0 and r["ranks"] == 2
+    # 3 ranks: the smallest NON-degenerate ring (a 2-rank ring always
+    # talks to the same peer, hiding neighbor-rotation bugs).
+    assert r["value"] > 0 and r["ranks"] == 3
     assert r["ms_per_round"] > 0

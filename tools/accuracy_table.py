@@ -74,14 +74,14 @@ def leg_docqa() -> dict:
 def leg_flagship() -> dict:
     """Flagship mesh-EASGD final test error per seed (the bench.py
     training config at its default epochs, no early stop)."""
-    from mpit_tpu.train.mesh_launch import MESH_LAUNCH_DEFAULTS, run
+    from mpit_tpu.train.mesh_launch import (
+        FLAGSHIP_BENCH_KWARGS, MESH_LAUNCH_DEFAULTS, run,
+    )
 
     errs, epochs = [], None
     for seed in SEEDS:
         cfg = MESH_LAUNCH_DEFAULTS.merged(
-            opt="easgd", model="cnn", epochs=30, batch=128, side=32,
-            su=10, mom=0.99, lr=1e-2, seed=seed, device_stream=1,
-            precompile=1,
+            **FLAGSHIP_BENCH_KWARGS, epochs=30, seed=seed,
         )
         result = run(cfg)
         errs.append(result["final_test_err"])
