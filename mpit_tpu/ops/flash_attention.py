@@ -602,7 +602,8 @@ def _rows_to_lanes(x, length_p):
 
 
 def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
-               sm_scale, block_q, block_k, interpret, precision):
+               sm_scale, block_q, block_k, interpret, precision,
+               fused=True):
     """Backward core on (Lq, D) x (Lk, D): returns (dq, dk, dv).
 
     ``lse``/``delta`` are per-q-row f32 vectors (log-sum-exp from the
@@ -631,13 +632,16 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
               precision=precision)
     interp = _interpret(interpret)
 
-    if os.environ.get("MPIT_FA_FUSED_BWD", "1") != "0":
+    if fused:
         # Fused single sweep: dK/dV accumulate in VMEM, dQ leaves as
         # per-kv-block partials — (n_kv_blocks, Lq, D) f32, each block
         # written exactly once — summed here.  5 matmuls per tile pair
         # vs the two-kernel schedule's 7; the partial buffer costs
         # n_kv_blocks * Lq * D * 4 bytes of transient HBM (64 MB at
         # L=16k, 512 MB at 32k on this shape) and one XLA reduction.
+        # Fused-vs-two-kernel selection (incl. the vmapped-batch HBM
+        # budget) lives in _use_fused_bwd; this function only executes
+        # the chosen schedule.
         nj = lk_p // bk
         kvrow2 = pl.BlockSpec((bk, d_p), lambda j, i: (j, 0),
                               memory_space=pltpu.VMEM)
@@ -668,7 +672,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
         dq = jnp.sum(dq_part, axis=0).astype(q.dtype)
         return dq[:lq, :d], dk[:lk, :d], dv[:lk, :d]
 
-    # Two-kernel fallback (MPIT_FA_FUSED_BWD=0).
+    # Two-kernel fallback (fused=False).
     # Kernel 1: dQ — q rows outer, kv blocks inner.
     qrow = pl.BlockSpec((bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
     qstat = pl.BlockSpec((bq, LANE), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
@@ -709,6 +713,41 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
     return dq[:lq, :d], dk[:lk, :d], dv[:lk, :d]
 
 
+def _use_fused_bwd(q_shape, k_shape, d, dtype, sm_scale, block_q, block_k):
+    """Backward-schedule choice (the ONE decision point, made where the
+    full vmapped batch shape is visible).
+
+    ``MPIT_FA_FUSED_BWD``: ``1`` forces the fused single sweep, ``0``
+    the two-kernel schedule (the CI A/B levers); default ``auto`` uses
+    fused only while its dQ-partials transient — (n_kv_blocks, Lq, D)
+    f32 *per vmapped (batch, head) program, all live at once* — fits
+    ``MPIT_FA_FUSED_BWD_MAX_MB`` (default 512).  The fused sweep saves
+    2 of 7 matmuls per tile pair; the transient is its price, and at
+    32k x 8 heads it reaches GBs (docs/KERNEL_BENCH.md)."""
+    mode = os.environ.get("MPIT_FA_FUSED_BWD", "auto") or "auto"
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    if mode != "auto":
+        # Fail loudly: pre-round-5 semantics treated any non-"0" value as
+        # force-fused, so a stray "true"/"2" silently flipping to the
+        # auto heuristic would corrupt A/B comparisons.
+        raise ValueError(
+            f"MPIT_FA_FUSED_BWD={mode!r}: expected '0', '1', or 'auto'"
+        )
+    lq, lk = q_shape[-2], k_shape[-2]
+    _, _, bk, lq_p, lk_p, d_p = _tile_dims(
+        lq, lk, d, block_q, block_k, sm_scale, dtype
+    )
+    batch = 1
+    for s in q_shape[:-2]:
+        batch *= int(s)
+    transient_mb = batch * (lk_p // bk) * lq_p * d_p * 4 / 2**20
+    budget = float(os.environ.get("MPIT_FA_FUSED_BWD_MAX_MB", "512"))
+    return transient_mb <= budget
+
+
 def flash_attention_bwd_pair(q, k, v, do, lse, *, causal=False, sm_scale=None,
                              q_offset=0, kv_offset=0, delta=None, o=None,
                              block_q=None, block_k=None, interpret=None,
@@ -723,10 +762,12 @@ def flash_attention_bwd_pair(q, k, v, do, lse, *, causal=False, sm_scale=None,
         if o is None:
             raise ValueError("flash_attention_bwd_pair needs delta or o")
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    fused = _use_fused_bwd(q.shape, k.shape, q.shape[-1], q.dtype,
+                           sm_scale, block_q, block_k)
     f = lambda q2, k2, v2, do2, lse2, delta2: _fa_2d_bwd(
         q2, k2, v2, do2, lse2, delta2, q_offset, kv_offset, causal=causal,
         sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-        interpret=interpret, precision=precision,
+        interpret=interpret, precision=precision, fused=fused,
     )
     for _ in range(q.ndim - 2):
         f = jax.vmap(f)

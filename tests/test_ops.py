@@ -182,6 +182,35 @@ def test_flash_grad_matches_reference(rng, fa_backward_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
+def test_fused_bwd_auto_gate(monkeypatch):
+    """The auto mode picks the fused sweep only while the dQ-partials
+    transient (batch x n_kv_blocks x Lq_p x D_p f32) fits the budget."""
+    from mpit_tpu.ops.flash_attention import _use_fused_bwd
+
+    monkeypatch.delenv("MPIT_FA_FUSED_BWD", raising=False)
+    # 8k, 8 heads, bf16 1024-blocks: 8 * 8 * 8192 * 128 * 4 = 256 MB.
+    args = ((1, 8, 8192, 128), (1, 8, 8192, 128), 128, jnp.bfloat16,
+            None, None, None)
+    assert _use_fused_bwd(*args) is True  # default budget 512 MB
+    monkeypatch.setenv("MPIT_FA_FUSED_BWD_MAX_MB", "255")
+    assert _use_fused_bwd(*args) is False
+    # 32k: 32 * 32768 * 128 * 4 x 8 heads = 4 GB >> default budget.
+    args32 = ((1, 8, 32768, 128), (1, 8, 32768, 128), 128, jnp.bfloat16,
+              None, None, None)
+    monkeypatch.delenv("MPIT_FA_FUSED_BWD_MAX_MB", raising=False)
+    assert _use_fused_bwd(*args32) is False
+    # The explicit levers stay unconditional.
+    monkeypatch.setenv("MPIT_FA_FUSED_BWD", "1")
+    assert _use_fused_bwd(*args32) is True
+    monkeypatch.setenv("MPIT_FA_FUSED_BWD", "0")
+    assert _use_fused_bwd(*args) is False
+    # Unknown values fail loudly (pre-r5 semantics force-fused on any
+    # non-"0" string — silent reinterpretation would corrupt A/Bs).
+    monkeypatch.setenv("MPIT_FA_FUSED_BWD", "true")
+    with pytest.raises(ValueError, match="MPIT_FA_FUSED_BWD"):
+        _use_fused_bwd(*args)
+
+
 def test_flash_dimsem_off_smoke(rng, monkeypatch):
     """MPIT_FA_DIMSEM=0 (unannotated grids, the other A/B lever) still
     produces correct forward and gradients."""
