@@ -150,6 +150,21 @@ def test_flash_offsets_pallas(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(full[C:]), atol=2e-5)
 
 
+def _assert_flash_grads_match(q, k, v):
+    """Shared grad check: squared-sum loss through the pallas path vs the
+    dense reference, 3e-5 atol (the ONE place the loss/tolerance live)."""
+    fa = lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=True, block_q=8, block_k=128) ** 2
+    )
+    ref = lambda q, k, v: jnp.sum(
+        attention_reference(q, k, v, causal=True) ** 2
+    )
+    for a, b in zip(jax.grad(fa, argnums=(0, 1, 2))(q, k, v),
+                    jax.grad(ref, argnums=(0, 1, 2))(q, k, v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+    return fa, ref
+
+
 @pytest.fixture
 def fa_backward_path(request, monkeypatch):
     """Pin the backward schedule (fused vs two-kernel) for one test.
@@ -166,20 +181,7 @@ def fa_backward_path(request, monkeypatch):
 @pytest.mark.parametrize("fa_backward_path", ["1", "0"], indirect=True,
                          ids=["fused-bwd", "two-kernel-bwd"])
 def test_flash_grad_matches_reference(rng, fa_backward_path):
-    q, k, v = _qkv(rng, (24, 16))
-
-    def loss_flash(q, k, v):
-        return jnp.sum(
-            flash_attention(q, k, v, causal=True, block_q=8, block_k=128) ** 2
-        )
-
-    def loss_ref(q, k, v):
-        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
-
-    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g1, g2):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+    _assert_flash_grads_match(*_qkv(rng, (24, 16)))
 
 
 def test_fused_bwd_auto_gate(monkeypatch):
@@ -211,6 +213,28 @@ def test_fused_bwd_auto_gate(monkeypatch):
         _use_fused_bwd(*args)
 
 
+def test_fused_bwd_auto_gate_end_to_end(rng, monkeypatch):
+    """auto mode over budget must route a REAL vmapped grad through the
+    two-kernel schedule and still match the reference — the gate's
+    integration path, not just its arithmetic."""
+    from mpit_tpu.ops.flash_attention import _use_fused_bwd
+
+    monkeypatch.delenv("MPIT_FA_FUSED_BWD", raising=False)
+    monkeypatch.setenv("MPIT_FA_FUSED_BWD_MAX_MB", "0.0001")
+    jax.clear_caches()
+    try:
+        q, k, v = _qkv(rng, (2, 24, 16))
+        # Pin the ROUTING first: with this budget the gate must pick the
+        # two-kernel schedule for exactly this call's shapes — without
+        # this, a gate regression (auto always fused) would still pass
+        # the numeric check below, since both schedules are correct.
+        assert _use_fused_bwd(q.shape, k.shape, q.shape[-1], q.dtype,
+                              None, 8, 128) is False
+        _assert_flash_grads_match(q, k, v)
+    finally:
+        jax.clear_caches()
+
+
 def test_flash_dimsem_off_smoke(rng, monkeypatch):
     """MPIT_FA_DIMSEM=0 (unannotated grids, the other A/B lever) still
     produces correct forward and gradients."""
@@ -218,18 +242,10 @@ def test_flash_dimsem_off_smoke(rng, monkeypatch):
     jax.clear_caches()
     try:
         q, k, v = _qkv(rng, (24, 16))
-        fa = lambda q, k, v: jnp.sum(
-            flash_attention(q, k, v, causal=True, block_q=8, block_k=128) ** 2
-        )
-        ref = lambda q, k, v: jnp.sum(
-            attention_reference(q, k, v, causal=True) ** 2
-        )
+        fa, ref = _assert_flash_grads_match(q, k, v)
         np.testing.assert_allclose(
             float(fa(q, k, v)), float(ref(q, k, v)), rtol=1e-5
         )
-        for a, b in zip(jax.grad(fa, argnums=(0, 1, 2))(q, k, v),
-                        jax.grad(ref, argnums=(0, 1, 2))(q, k, v)):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
     finally:
         jax.clear_caches()
 
