@@ -3,9 +3,10 @@
 Round 4 bisected the forward's remaining gap to the online-softmax
 state update (docs/KERNEL_BENCH.md §0): the stripped kernel runs at 92%
 of bf16 peak, adding the (m, l) scratch chain drops it to ~60%.  The
-state update runs ONCE PER KV BLOCK, so larger blocks amortize it —
-this sweep walks (block_q, block_k) combos upward until the scoped-VMEM
-ceiling (16 MB; the (block_q, block_k) f32 score tile is the hog) and
+state update runs ONCE PER KV BLOCK, so larger block_k amortizes it —
+this sweep walks (block_q, block_k) combos under a raised 64 MB VMEM
+budget (``MPIT_FA_VMEM_MB``, set below; the stock 16 MB budget rejects
+any combo whose (block_q, block_k) f32 score tile exceeds ~4 MB) and
 reports TFLOP/s + MFU per combo, compile failures recorded not fatal.
 
 Usage: `python benchmarks/flash_block_sweep.py` (env: MPIT_KBENCH_ITERS,
@@ -35,11 +36,17 @@ OUT = os.environ.get("MPIT_SWEEP_OUT", "")
 B, H, D = 1, 8, 128
 
 # (block_q, block_k): current default first, then the state-update
-# amortization candidates.  s-tile f32 VMEM = bq*bk*4: 1024x1024 = 4 MB
-# (known good), 1024x2048 / 2048x1024 = 8 MB (the edge), 2048x2048 =
-# 16 MB (expected to exceed scoped VMEM; recorded as evidence).
+# amortization candidates.  Prior data (docs/tpu_compile_notes.md §2,
+# 100 MB VMEM budget): BIGGER block_q is slower (2048x1024 = 97 vs
+# 1024x1024 = 102 TFLOP/s — less double-buffering overlap), but
+# bk-heavy combos (1024x2048, 512x2048) — the serialization lever of
+# KERNEL_BENCH §0.5 — were never measured.  The whole sweep runs under
+# MPIT_FA_VMEM_MB=64 (set below; perf-neutral per the same note), with
+# (1024, 1024) re-measured under it as the in-sweep control.
 COMBOS = [(1024, 1024), (1024, 2048), (2048, 1024), (1536, 1536),
-          (2048, 512), (512, 2048), (2048, 2048)]
+          (2048, 512), (512, 2048), (512, 4096), (2048, 2048)]
+
+os.environ.setdefault("MPIT_FA_VMEM_MB", "64")
 
 
 def main() -> None:

@@ -62,12 +62,23 @@ def _fa_compiler_params():
     axis (q rows fwd/dq, kv rows dk/dv) is embarrassingly parallel, the
     second is the sequential accumulation sweep over VMEM scratch.
     Declaring this lets Mosaic schedule the parallel axis freely.
-    MPIT_FA_DIMSEM=0 reverts to unannotated grids (A/B lever)."""
-    if os.environ.get("MPIT_FA_DIMSEM", "1") == "0":
-        return None
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "arbitrary")
-    )
+    MPIT_FA_DIMSEM=0 reverts to unannotated grids (A/B lever).
+
+    ``MPIT_FA_VMEM_MB`` raises the scoped-VMEM budget from the 16 MB
+    default — required to even compile block combos whose f32 score
+    tile exceeds ~4 MB (e.g. block_k=2048 sweeps,
+    benchmarks/flash_block_sweep.py); the 100 MB-budget sweep data in
+    docs/tpu_compile_notes.md §2 shows the raise itself is perf-neutral
+    for the default tiles."""
+    kwargs = {}
+    vmem_mb = float(os.environ.get("MPIT_FA_VMEM_MB") or 0)
+    # 0 (or unset/empty) means the stock budget — the sibling
+    # MPIT_FA_DIMSEM lever's 0-means-off convention.
+    if vmem_mb > 0:
+        kwargs["vmem_limit_bytes"] = int(vmem_mb * 2**20)
+    if os.environ.get("MPIT_FA_DIMSEM", "1") != "0":
+        kwargs["dimension_semantics"] = ("parallel", "arbitrary")
+    return pltpu.CompilerParams(**kwargs) if kwargs else None
 
 
 # ---------------------------------------------------------------------------
