@@ -116,6 +116,20 @@ def main() -> None:
                 nj = -(-L // bk)
                 rec["dq_partials_mb_analytic"] = round(
                     B * H * nj * L * D * 4 / 2**20, 1)
+                # What the SHIPPING default (MPIT_FA_FUSED_BWD=auto)
+                # chooses at this shape — so the aggregate record shows
+                # whether each measured row is the default path.
+                from mpit_tpu.ops.flash_attention import _use_fused_bwd
+
+                import jax.numpy as jnp
+                prev = os.environ.pop("MPIT_FA_FUSED_BWD", None)
+                try:
+                    rec["auto_picks_fused"] = _use_fused_bwd(
+                        (B, H, L, D), (B, H, L, D), D, jnp.bfloat16,
+                        None, None, None)
+                finally:
+                    if prev is not None:
+                        os.environ["MPIT_FA_FUSED_BWD"] = prev
             rows.append(rec)
             _log(f"[bwd-ab] {rec}")
     from _common import emit_json
