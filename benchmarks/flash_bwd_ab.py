@@ -62,6 +62,19 @@ def child(mode: str, L: int) -> None:
             return None
 
     rec = {"mode": mode, "L": L, "peak_before": stats()}
+    # memory_stats() is unavailable on the axon-tunneled runtime (returns
+    # None) — XLA's own compile-time accounting is the measured-HBM
+    # substitute: temp_size covers every transient the schedule
+    # allocates, including the fused path's dQ partials.
+    try:
+        grad = grad.lower(q, k, v).compile()  # AOT: compile exactly once
+        ma = grad.memory_analysis()
+        rec["xla_temp_mb"] = round(ma.temp_size_in_bytes / 2**20, 1)
+        rec["xla_peak_mb"] = round(
+            (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+             + ma.output_size_in_bytes) / 2**20, 1)
+    except Exception as e:
+        rec["xla_memory_analysis"] = f"unavailable: {type(e).__name__}"
     try:
         iters = int(os.environ.get("MPIT_KBENCH_ITERS", "10"))
         t = timed_per_call(grad, q, k, v, iters=iters, auto_scale=True,

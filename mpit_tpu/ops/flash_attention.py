@@ -648,8 +648,10 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
         # per-kv-block partials — (n_kv_blocks, Lq, D) f32, each block
         # written exactly once — summed here.  5 matmuls per tile pair
         # vs the two-kernel schedule's 7; the partial buffer costs
-        # n_kv_blocks * Lq * D * 4 bytes of transient HBM (64 MB at
-        # L=16k, 512 MB at 32k on this shape) and one XLA reduction.
+        # n_kv_blocks * Lq * D * 4 bytes of transient HBM per (B, H)
+        # program (128 MB at L=16k, 512 MB at 32k with 1024-wide kv
+        # blocks — x batch*heads live at once under vmap) and one XLA
+        # reduction.
         # Fused-vs-two-kernel selection (incl. the vmapped-batch HBM
         # budget) lives in _use_fused_bwd; this function only executes
         # the chosen schedule.
@@ -732,9 +734,14 @@ def _use_fused_bwd(q_shape, k_shape, d, dtype, sm_scale, block_q, block_k):
     the two-kernel schedule (the CI A/B levers); default ``auto`` uses
     fused only while its dQ-partials transient — (n_kv_blocks, Lq, D)
     f32 *per vmapped (batch, head) program, all live at once* — fits
-    ``MPIT_FA_FUSED_BWD_MAX_MB`` (default 512).  The fused sweep saves
-    2 of 7 matmuls per tile pair; the transient is its price, and at
-    32k x 8 heads it reaches GBs (docs/KERNEL_BENCH.md)."""
+    ``MPIT_FA_FUSED_BWD_MAX_MB`` (default 2048).  The fused sweep saves
+    2 of 7 matmuls per tile pair; the round-5 on-chip A/B
+    (docs/KERNEL_BENCH.md §0.6) measured it faster at every length
+    (-5.5% at 8k, -5.7% at 16k, -7.0% at 32k on the B=1 H=8 D=128
+    bench shape), so the budget is sized to admit the 1 GB transient at 16k
+    and refuse the 4 GB one at 32k — the kernel-level win there is not
+    worth an OOM risk inside composite training programs; raise the
+    budget for pure-attention workloads with HBM to spare."""
     mode = os.environ.get("MPIT_FA_FUSED_BWD", "auto") or "auto"
     if mode == "0":
         return False
@@ -755,7 +762,7 @@ def _use_fused_bwd(q_shape, k_shape, d, dtype, sm_scale, block_q, block_k):
     for s in q_shape[:-2]:
         batch *= int(s)
     transient_mb = batch * (lk_p // bk) * lq_p * d_p * 4 / 2**20
-    budget = float(os.environ.get("MPIT_FA_FUSED_BWD_MAX_MB", "512"))
+    budget = float(os.environ.get("MPIT_FA_FUSED_BWD_MAX_MB", "2048"))
     return transient_mb <= budget
 
 
