@@ -65,6 +65,49 @@ def test_measure_throughput_reports_steady_rate():
     assert res["samples_per_sec_steady"] > 0
 
 
+def test_device_loop_stops_at_target_after_one_epoch():
+    """device_loop=1: the while_loop's on-device early exit — a target
+    any first epoch meets must end the program after exactly one epoch,
+    with a real time_to_target."""
+    res = run(_tiny_cfg(opt="easgd", su=2, mva=0.2, lr=0.1, mom=0.9,
+                        epochs=6, device_loop=1, stop_at_target=1,
+                        target_test_err=0.95))
+    assert len(res["history"]) == 1
+    assert res["time_to_target"] is not None
+    assert res["history"][0]["at"] is not None
+
+
+def test_device_loop_runs_all_epochs_and_learns():
+    res = run(_tiny_cfg(opt="easgd", su=2, mva=0.2, lr=0.1, mom=0.9,
+                        epochs=3, device_loop=1))
+    assert [h["epoch"] for h in res["history"]] == [0, 1, 2]
+    errs = [h["test_err"] for h in res["history"]]
+    assert all(np.isfinite(e) for e in errs)
+    # Learns: linear model on digits drops well under chance in 3 epochs.
+    assert res["final_test_err"] < 0.5
+    assert res["samples_per_sec"] and res["samples_per_sec"] > 0
+    # Only the final wall timestamp is real (one dispatch ran them all).
+    assert res["history"][-1]["at"] is not None
+    assert all(h["at"] is None for h in res["history"][:-1])
+    # No target stop requested -> no time_to_target claim.
+    assert res["time_to_target"] is None
+
+
+def test_device_loop_syncdp_smoke():
+    res = run(_tiny_cfg(opt="syncdp", lr=0.2, mom=0.9, batch=64,
+                        epochs=2, device_loop=1))
+    assert len(res["history"]) == 2
+    assert np.isfinite(res["final_test_err"])
+
+
+def test_device_loop_rejects_ckpt_and_resume(tmp_path):
+    with pytest.raises(ValueError, match="device_loop"):
+        run(_tiny_cfg(opt="easgd", device_loop=1, ckpt_dir=str(tmp_path)))
+    with pytest.raises(ValueError, match="device_loop"):
+        run(_tiny_cfg(opt="easgd", device_loop=1, resume="auto",
+                      ckpt_dir=str(tmp_path)))
+
+
 def test_checkpoint_resume_matches_straight_run(tmp_path):
     """2 epochs + resume for 2 more must reproduce the straight 4-epoch
     run exactly: same data order (burned permutations), same losses."""
