@@ -9,11 +9,14 @@ time-to-test-error loop, asyncsgd/ptest.lua:58-67 push/pull MB/s):
   framework's device_stream input pipeline); every step trains a
   different batch; timing is the latency-cancelled fetch-fenced recipe
   of :mod:`mpit_tpu.utils.timing` over whole epoch passes.
-- ``time_to_target_s`` — wall-clock from process t0 to the first epoch
-  whose test error <= ``target_test_err`` (includes compile, as a user
-  would experience it).  ``data_source`` names what was trained on — this
-  environment has no real MNIST; the loader falls back to sklearn-digits
-  (data/mnist.py docstring).
+- ``time_to_target_s`` — wall-clock from post-compile t0 until test
+  error <= ``target_test_err`` (compile is AOT/warmed and reported
+  separately as ``compile_s``).  Default mode is ``device_loop``: the
+  entire train-to-target runs as one ``lax.while_loop`` device program,
+  so the number measures the device rather than per-epoch tunnel RTTs
+  (on-chip A/B in docs/NORTHSTAR_r5.md).  ``data_source`` names what
+  was trained on — this environment has no real MNIST; the loader uses
+  the committed optdigits fixture (data/mnist.py docstring).
 - ``ps_pushpull_mbs_per_chip`` — bi-directional PS shard push/pull
   bandwidth per chip over the mesh ``shard`` axis (the ptest.lua
   measurement riding ICI collectives instead of MPI).
@@ -99,12 +102,23 @@ def bench_train() -> dict:
     # — 2% is the achievable stand-in, and the JSON names both the target
     # and the source.
     target = float(os.environ.get("MPIT_BENCH_TARGET", "0.02"))
+    # device_loop=1: the whole train-to-target runs as ONE lax.while_loop
+    # device program (on-device shuffle + epoch scan + eval + early
+    # exit), so time_to_target measures the device, not the tunnel RTT —
+    # flipped after the on-chip A/B measured 1.0 s vs 4.3 s median for
+    # the host epoch loop on this exact config (benchmarks/
+    # device_loop_ab.py, docs/NORTHSTAR_r5.md).  The steady-throughput
+    # leg is mode-independent (same compiled epoch scan either way).
+    # MPIT_BENCH_DEVICE_LOOP=0 restores the host-loop measurement.
+    device_loop = int(os.environ.get("MPIT_BENCH_DEVICE_LOOP", "1"))
     cfg = MESH_LAUNCH_DEFAULTS.merged(
         **FLAGSHIP_BENCH_KWARGS, epochs=EPOCHS,
         target_test_err=target, stop_at_target=1, measure_throughput=1,
+        device_loop=device_loop,
     )
     result = run(cfg)
     result["target_test_err"] = target
+    result["train_mode"] = "device_loop" if device_loop else "host_loop"
     err = result["final_test_err"]
     _log(
         f"train: {result['samples_trained']} samples in "
@@ -288,6 +302,7 @@ def main():
         "time_to_target_runs": [round(v, 3) for v in ttt_runs],
         "compile_s": round(_median(compile_runs), 3) if compile_runs else None,
         "target_test_err": train["target_test_err"],
+        "train_mode": train["train_mode"],
         "measurement_condition": "BASELINE.md §'Measurement condition in "
         "THIS environment' (optdigits-8x8 fixture, 2% target; no-egress "
         "environment, real MNIST unavailable)",

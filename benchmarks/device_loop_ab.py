@@ -1,0 +1,85 @@
+"""A/B: host epoch loop vs device_loop for flagship time-to-target.
+
+The host loop pays >=2 blocking host<->device RTTs per epoch (loss +
+test-error fetch) plus an H2D epoch stage; ``device_loop=1`` runs the
+whole train-to-target as ONE ``lax.while_loop`` program (mesh_launch
+``_device_loop_train``).  This leg measures both modes on the flagship
+bench config (the exact ``bench.py`` training) so the flip decision for
+the headline ``time_to_target_s`` rests on an on-chip comparison, not
+the RTT argument alone.
+
+Each rep is a fresh ``run()`` (fresh trainer state; the persistent
+compile cache keeps recompiles warm).  One JSON line:
+``{"metric": "device_loop_ab", "host": {...}, "device_loop": {...}}``
+with per-rep time_to_target/compile/final_err per mode.
+
+Env: MPIT_AB_REPS (default 3), MPIT_AB_TARGET (default 0.02),
+MPIT_AB_EPOCHS (default 30), MPIT_KBENCH_OUT (append JSON here too).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _common import emit_json, log, setup_platform  # noqa: E402
+
+setup_platform()
+
+REPS = int(os.environ.get("MPIT_AB_REPS", "3"))
+TARGET = float(os.environ.get("MPIT_AB_TARGET", "0.02"))
+EPOCHS = int(os.environ.get("MPIT_AB_EPOCHS", "30"))
+OUT = os.environ.get("MPIT_KBENCH_OUT", "")
+
+
+def _one(device_loop: int) -> dict:
+    from mpit_tpu.train.mesh_launch import (
+        FLAGSHIP_BENCH_KWARGS, MESH_LAUNCH_DEFAULTS, run,
+    )
+
+    cfg = MESH_LAUNCH_DEFAULTS.merged(
+        **FLAGSHIP_BENCH_KWARGS, epochs=EPOCHS, target_test_err=TARGET,
+        stop_at_target=1, device_loop=device_loop,
+    )
+    r = run(cfg)
+    return {
+        "time_to_target": r["time_to_target"],
+        "compile_s": r["compile_s"],
+        "final_test_err": r["final_test_err"],
+        "epochs_run": len(r["history"]),
+    }
+
+
+def _leg(device_loop: int) -> dict:
+    reps = [_one(device_loop) for _ in range(REPS)]
+    ttt = sorted(r["time_to_target"] for r in reps
+                 if r["time_to_target"] is not None)
+    med = ttt[len(ttt) // 2] if ttt else None
+    out = {
+        "median_ttt_s": round(med, 3) if med is not None else None,
+        "ttt_runs": [round(r["time_to_target"], 3)
+                     if r["time_to_target"] is not None else None
+                     for r in reps],
+        "compile_runs": [round(r["compile_s"], 3) for r in reps],
+        "final_err_runs": [round(r["final_test_err"], 4) for r in reps],
+        "epochs_runs": [r["epochs_run"] for r in reps],
+    }
+    log(f"[device_loop_ab] device_loop={device_loop}: {out}")
+    return out
+
+
+def main() -> None:
+    rec = {
+        "metric": "device_loop_ab",
+        "target_test_err": TARGET,
+        "reps": REPS,
+        "host": _leg(0),
+        "device_loop": _leg(1),
+    }
+    emit_json(rec, OUT)
+
+
+if __name__ == "__main__":
+    main()

@@ -257,6 +257,15 @@ class MeshEASGD:
     def center_params(self, state: Dict[str, Any]) -> jnp.ndarray:
         return state["center"]
 
+    def set_steps(self, n: int) -> None:
+        """Resynchronize the host-side sync-schedule counter after steps
+        were advanced outside :meth:`step`/:meth:`run_epoch` — e.g. the
+        device_loop trainer runs the epoch scan inside a
+        ``lax.while_loop``, advancing the device-resident schedule
+        without touching this counter.  Trainer-owned so the invariant
+        lives where the counter does."""
+        self._steps = int(n)
+
     def run_epoch(self, state: Dict[str, Any], x_ep: jnp.ndarray,
                   y_ep: jnp.ndarray):
         """Train a whole staged epoch — ``(nsteps, n_dp, batch, ...)``

@@ -120,6 +120,11 @@ class SyncDataParallel:
         w, vt, k, loss = self._step_jit(state["w"], state["vt"], state["k"], xb, yb)
         return {"w": w, "vt": vt, "k": k}, loss
 
+    def set_steps(self, n: int) -> None:
+        """Sync-DP keeps no host-side schedule (the step count ``k``
+        lives in device state) — accepted for trainer-interface parity
+        with :class:`~mpit_tpu.parallel.easgd.MeshEASGD.set_steps`."""
+
     def precompile(self, state: Dict[str, Any], *batch: jnp.ndarray) -> None:
         """Compile-and-warm the step program against the real shardings
         without consuming the caller's buffers (the jit donates w/vt, so

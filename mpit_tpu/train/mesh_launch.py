@@ -111,7 +111,14 @@ def _device_loop_train(*, cfg, trainer, state, eval_params, err_fn, mesh,
     host-driven by construction — goot.lua:129-146; a device-resident
     data-dependent training loop is XLA-native ground.)
 
-    Trade-offs (why the host loop remains the default): the shuffle is
+    On-chip A/B on the flagship bench config (3 reps each mode,
+    benchmarks/device_loop_ab.py, 2026-07-31): host loop median
+    time-to-target 4.28 s (runs 6.07/4.28/4.12), device_loop **1.01 s**
+    (0.94/1.01/1.21) — the whole gap was per-epoch host round trips.
+    bench.py therefore defaults to device_loop=1 for the headline
+    time_to_target_s (MPIT_BENCH_DEVICE_LOOP=0 restores the host loop).
+
+    Trade-offs (why the host loop remains the general default): the shuffle is
     jax.random rather than the host path's numpy rng (equally random,
     but trajectories are not bit-comparable across modes), per-epoch
     wall timestamps do not exist (only the final ``at`` is real), and
@@ -179,7 +186,7 @@ def _device_loop_train(*, cfg, trainer, state, eval_params, err_fn, mesh,
     # per executed epoch — resynchronize it with the device-resident
     # schedule so any subsequent step()/run_epoch use (e.g. the
     # measure_throughput leg) continues the true global sync phase.
-    trainer._steps = ep * steps_per_epoch
+    trainer.set_steps(ep * steps_per_epoch)
 
     history = [
         {"epoch": i, "avg_loss": float(losses[i]),
@@ -192,7 +199,21 @@ def _device_loop_train(*, cfg, trainer, state, eval_params, err_fn, mesh,
         log.info("epoch %d avg_loss %.5f test_err %.4f",
                  h["epoch"], h["avg_loss"], h["test_err"])
     hit_target = bool(ep and errs[ep - 1] <= float(cfg.target_test_err))
+    # Contract difference vs the host loop: with stop_at_target=0 the
+    # host loop reports time_to_target at whichever epoch first met the
+    # target mid-run; inside one device program no per-epoch wall
+    # timestamp exists, so a mid-run hit has no honest wall time to
+    # report — time_to_target is defined here ONLY when the program
+    # early-exits at the target (stop_at_target=1).
     time_to_target = wall if (cfg.stop_at_target and hit_target) else None
+    if (not cfg.stop_at_target
+            and any(errs[:ep] <= float(cfg.target_test_err))):
+        log.warning(
+            "device_loop: target %.4f was reached mid-run but "
+            "stop_at_target=0 — no per-epoch wall times exist inside the "
+            "device program, so time_to_target stays None (use "
+            "stop_at_target=1 or the host loop to measure it)",
+            float(cfg.target_test_err))
     log.info("device-loop: %d epoch(s) in %.2fs wall (one dispatch)",
              ep, wall)
     return state, history, time_to_target, compile_s, wall, ep * take, t0
@@ -567,7 +588,13 @@ def run(cfg: Config) -> dict:
     per_epoch = steps_per_epoch * per_step
     if cfg.device_loop:
         # One wall covers every epoch (single dispatch); compile was AOT,
-        # outside the wall.
+        # outside the wall.  NOT comparable with the host-loop figure:
+        # this wall includes the per-epoch on-device eval + shuffle and
+        # the dispatch/fetch RTT, where the host path times training
+        # only (eval after the per-epoch timer stops) — the result dict
+        # carries train_wall_mode so readers of samples_per_sec know
+        # which definition they got; samples_per_sec_steady is the
+        # mode-independent rate.
         sps = samples_trained / train_time if train_time > 0 else None
     else:
         sps = len(ss) * per_epoch / sum(ss) if ss and sum(ss) > 0 else None
@@ -617,6 +644,10 @@ def run(cfg: Config) -> dict:
         "samples_trained": samples_trained,
         "samples_per_sec": round(sps, 1) if sps else None,
         "samples_per_sec_steady": round(sps_steady, 1) if sps_steady else None,
+        # Which wall fed samples_per_sec: "device_loop" includes eval +
+        # shuffle inside the one program's wall; "host_loop" times
+        # training only.  steady is mode-independent.
+        "train_wall_mode": "device_loop" if cfg.device_loop else "host_loop",
         "compile_s": round(compile_s, 3) if compile_s is not None else None,
         "data_source": source,
         "mesh": {"dp": n_dp, "shard": mesh.shape["shard"]},

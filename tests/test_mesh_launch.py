@@ -100,6 +100,43 @@ def test_device_loop_syncdp_smoke():
     assert np.isfinite(res["final_test_err"])
 
 
+def test_device_loop_mid_run_target_without_stop_is_none():
+    """Contract difference, pinned: with stop_at_target=0 and a target
+    met mid-run, the host loop reports time_to_target at that epoch but
+    device_loop returns None (no per-epoch wall timestamps exist inside
+    one device program; run() logs a warning naming the fix).  A caller
+    toggling modes must see the difference, not a silently shifted
+    number."""
+    kw = dict(opt="easgd", su=2, mva=0.2, lr=0.1, mom=0.9,
+              epochs=3, stop_at_target=0, target_test_err=0.95)
+    host = run(_tiny_cfg(**kw))
+    dev = run(_tiny_cfg(device_loop=1, **kw))
+    assert host["time_to_target"] is not None
+    assert dev["time_to_target"] is None
+
+
+def test_train_wall_mode_reported():
+    host = run(_tiny_cfg(opt="easgd", su=2, mva=0.2, epochs=1))
+    dev = run(_tiny_cfg(opt="easgd", su=2, mva=0.2, epochs=1,
+                        device_loop=1))
+    assert host["train_wall_mode"] == "host_loop"
+    assert dev["train_wall_mode"] == "device_loop"
+
+
+def test_set_steps_resyncs_easgd_schedule():
+    """device_loop resyncs the trainer's host-side sync counter through
+    the trainer-owned set_steps — the elastic schedule must continue in
+    the true global phase for any follow-on step()/run_epoch use."""
+    from mpit_tpu.train.mesh_launch import FLAGSHIP_BENCH_KWARGS  # noqa: F401
+    from mpit_tpu.parallel.easgd import MeshEASGD
+
+    assert callable(MeshEASGD.set_steps)
+    # run() under device_loop leaves the counter at epochs*steps.
+    res = run(_tiny_cfg(opt="easgd", su=2, mva=0.2, epochs=2,
+                        device_loop=1, measure_throughput=1))
+    assert res["samples_per_sec_steady"] is not None
+
+
 def test_device_loop_rejects_ckpt_and_resume(tmp_path):
     with pytest.raises(ValueError, match="device_loop"):
         run(_tiny_cfg(opt="easgd", device_loop=1, ckpt_dir=str(tmp_path)))
