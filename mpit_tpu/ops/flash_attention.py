@@ -57,7 +57,7 @@ NEG_INF = float("-inf")
 _BIG_NEG = -1e30
 
 
-def _fa_compiler_params():
+def _fa_compiler_params(vmem_mb_auto: float = 0.0):
     """Grid dimension semantics for every flash kernel: the first grid
     axis (q rows fwd/dq, kv rows dk/dv) is embarrassingly parallel, the
     second is the sequential accumulation sweep over VMEM scratch.
@@ -69,11 +69,14 @@ def _fa_compiler_params():
     tile exceeds ~4 MB (e.g. block_k=2048 sweeps,
     benchmarks/flash_block_sweep.py); the 100 MB-budget sweep data in
     docs/tpu_compile_notes.md §2 shows the raise itself is perf-neutral
-    for the default tiles."""
+    for the default tiles.  ``vmem_mb_auto`` is the caller's computed
+    floor for configs that cannot compile under the stock budget (the
+    length-aware block_q=2048 forward default); the env lever, when
+    set, wins over it — including an explicit 0, which pins the stock
+    budget (the A/B control) and suppresses the auto raise."""
     kwargs = {}
-    vmem_mb = float(os.environ.get("MPIT_FA_VMEM_MB") or 0)
-    # 0 (or unset/empty) means the stock budget — the sibling
-    # MPIT_FA_DIMSEM lever's 0-means-off convention.
+    env = os.environ.get("MPIT_FA_VMEM_MB", "")
+    vmem_mb = float(env) if env else vmem_mb_auto
     if vmem_mb > 0:
         kwargs["vmem_limit_bytes"] = int(vmem_mb * 2**20)
     if os.environ.get("MPIT_FA_DIMSEM", "1") != "0":
@@ -293,12 +296,27 @@ def _default_blocks(dtype) -> Tuple[int, int]:
     return (1024, 1024) if jnp.dtype(dtype).itemsize <= 2 else (512, 512)
 
 
-def _tile_dims(lq, lk, d, block_q, block_k, sm_scale, dtype):
+def _tile_dims(lq, lk, d, block_q, block_k, sm_scale, dtype,
+               fwd_long_bq=False):
     """Shared forward/backward tiling contract: softmax scale, clamped
     block sizes and padded dims.  The backward's saved-LSE rows only line
-    up with recomputed score tiles if both directions use exactly this.
-    ``block_q``/``block_k`` of None resolve to the dtype default."""
+    up with recomputed score tiles if both directions use exactly this
+    scale/padding; block sizes themselves may differ per direction (the
+    forward slices outputs back to true lq, and LSE/delta are per-row).
+    ``block_q``/``block_k`` of None resolve to the dtype default.
+
+    ``fwd_long_bq`` (forward only): at Lq >= 16384 bf16 the 3-rep
+    on-chip A/B measured block_q=2048 faster than 1024 (16k: 4.90 vs
+    5.07 ms; 32k: 18.41 vs 19.00 ms, 60.6% MFU) while at 8k it is ~3%
+    slower (docs/KERNEL_BENCH.md §0.5), so the default grows with the
+    sequence.  MPIT_FA_LONG_BQ=0 pins the flat 1024 default.  Not
+    applied to the backward kernels (unmeasured there; they hold more
+    live tiles per program)."""
     dq, dk = _default_blocks(dtype)
+    if (fwd_long_bq and block_q is None and lq >= 16384
+            and jnp.dtype(dtype).itemsize <= 2
+            and os.environ.get("MPIT_FA_LONG_BQ", "1") != "0"):
+        dq = 2048
     block_q = dq if block_q is None else block_q
     block_k = dk if block_k is None else block_k
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -322,12 +340,17 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
     lq, d = q.shape
     lk = k.shape[0]
     scale, bq, bk, lq_p, lk_p, d_p = _tile_dims(
-        lq, lk, d, block_q, block_k, sm_scale, q.dtype
+        lq, lk, d, block_q, block_k, sm_scale, q.dtype, fwd_long_bq=True
     )
     qp = jnp.pad(q, ((0, lq_p - lq), (0, d_p - d)))
     kp = jnp.pad(k, ((0, lk_p - lk), (0, d_p - d)))
     vp = jnp.pad(v, ((0, lk_p - lk), (0, d_p - d)))
     grid = (lq_p // bq, lk_p // bk)
+    # The (bq, bk) f32 score tile at bq=2048 (8 MB) cannot compile under
+    # the stock scoped-VMEM budget; request the 64 MB budget measured
+    # perf-neutral for every tile geometry (docs/tpu_compile_notes.md §2)
+    # whenever the resolved tile needs it.
+    vmem_auto = 64.0 if bq * bk * 4 > 4 * 2**20 else 0.0
 
     sspec = pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
     qspec = pl.BlockSpec((bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
@@ -361,7 +384,7 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
             pltpu.VMEM((bq, LANE), jnp.float32),
         ],
         interpret=_interpret(interpret),
-        compiler_params=_fa_compiler_params(),
+        compiler_params=_fa_compiler_params(vmem_auto),
     )(
         jnp.asarray(q_offset, jnp.int32).reshape(1, 1),
         jnp.asarray(kv_offset, jnp.int32).reshape(1, 1),
@@ -850,10 +873,12 @@ def flash_attention(
     """Flash attention over ``(..., L, D)`` with global-offset causal
     masking.  Leading axes are batched (vmapped); offsets may be traced.
 
-    Default blocks are 1024x1024 — measured 2.7-3x faster than 256x512
-    on TPU v5e (docs/KERNEL_BENCH.md; 2048 blocks exceed scoped VMEM);
-    ``_tile_dims`` clamps blocks for short sequences, so the default is
-    safe at any L.
+    Default blocks are 1024x1024 (measured 2.7-3x faster than 256x512
+    on TPU v5e, docs/KERNEL_BENCH.md), growing to 2048x1024 at
+    L >= 16384 where the on-chip A/B measured it ~3% faster still
+    (§0.5; MPIT_FA_LONG_BQ=0 pins 1024 — the kernel auto-raises its
+    scoped-VMEM budget for the bigger score tile).  ``_tile_dims``
+    clamps blocks for short sequences, so the default is safe at any L.
 
     ``precision``: MXU input precision for the two block matmuls (e.g.
     ``"highest"`` for full-f32 inputs); None uses the backend default —

@@ -184,6 +184,29 @@ def test_flash_grad_matches_reference(rng, fa_backward_path):
     _assert_flash_grads_match(*_qkv(rng, (24, 16)))
 
 
+def test_fwd_long_bq_block_routing(monkeypatch):
+    """Length-aware forward default (KERNEL_BENCH §0.5 A/B): block_q
+    grows to 2048 at Lq >= 16384 bf16 — forward only, explicit blocks
+    and the env kill-switch win, f32 keeps its 512 default."""
+    from mpit_tpu.ops.flash_attention import _tile_dims
+
+    def bq_of(lq, dtype=jnp.bfloat16, fwd=True, block_q=None, **env):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        out = _tile_dims(lq, lq, 128, block_q, None, None, dtype,
+                         fwd_long_bq=fwd)
+        monkeypatch.delenv("MPIT_FA_LONG_BQ", raising=False)
+        return out[1]
+
+    assert bq_of(8192) == 1024          # short: flat default
+    assert bq_of(16384) == 2048         # long forward: grown
+    assert bq_of(32768) == 2048
+    assert bq_of(32768, fwd=False) == 1024          # backward: unchanged
+    assert bq_of(32768, block_q=1024) == 1024       # explicit wins
+    assert bq_of(32768, MPIT_FA_LONG_BQ="0") == 1024  # env kill-switch
+    assert bq_of(32768, dtype=jnp.float32) == 512   # f32 path untouched
+
+
 def test_fused_bwd_auto_gate(monkeypatch):
     """The auto mode picks the fused sweep only while the dQ-partials
     transient (batch x n_kv_blocks x Lq_p x D_p f32) fits the budget."""
