@@ -84,6 +84,17 @@ def _fa_compiler_params(vmem_mb_auto: float = 0.0):
     return pltpu.CompilerParams(**kwargs) if kwargs else None
 
 
+def _vmem_auto(bq: int, bk: int) -> float:
+    """Auto scoped-VMEM floor (MB) for a resolved tile geometry: a
+    >4 MB f32 score tile (the length-aware 2048-block defaults) cannot
+    compile under the stock budget, so request the 64 MB budget
+    measured perf-neutral for every geometry (docs/tpu_compile_notes.md
+    §2).  ONE copy shared by forward and backward so a retune cannot
+    diverge them; an explicit MPIT_FA_VMEM_MB (incl. =0) still wins in
+    :func:`_fa_compiler_params`."""
+    return 64.0 if bq * bk * 4 > 4 * 2**20 else 0.0
+
+
 # ---------------------------------------------------------------------------
 # jnp reference + partial/merge algebra (differentiable, CPU-friendly)
 # ---------------------------------------------------------------------------
@@ -297,7 +308,7 @@ def _default_blocks(dtype) -> Tuple[int, int]:
 
 
 def _tile_dims(lq, lk, d, block_q, block_k, sm_scale, dtype,
-               fwd_long_bq=False):
+               fwd_long_bq=False, bwd_long_bk=False):
     """Shared forward/backward tiling contract: softmax scale, clamped
     block sizes and padded dims.  The backward's saved-LSE rows only line
     up with recomputed score tiles if both directions use exactly this
@@ -309,14 +320,30 @@ def _tile_dims(lq, lk, d, block_q, block_k, sm_scale, dtype,
     on-chip A/B measured block_q=2048 faster than 1024 (16k: 4.90 vs
     5.07 ms; 32k: 18.41 vs 19.00 ms, 60.6% MFU) while at 8k it is ~3%
     slower (docs/KERNEL_BENCH.md §0.5), so the default grows with the
-    sequence.  MPIT_FA_LONG_BQ=0 pins the flat 1024 default.  Not
-    applied to the backward kernels (unmeasured there; they hold more
-    live tiles per program)."""
+    sequence.  MPIT_FA_LONG_BQ=0 pins the flat 1024 default.
+
+    ``bwd_long_bk`` (backward, fused schedule only — callers pass the
+    resolved ``fused`` flag): at Lk >= 32768 bf16 the 32k sweep
+    measured block_k=2048 the clear backward winner (fwd+bwd 74.0 ->
+    63-67 ms; KERNEL_BENCH §0.5): fewer, wider kv blocks halve the
+    fused schedule's dQ-partials transient (4 GB -> 2 GB on the bench
+    shape, re-admitting the fused path under the auto budget) on top of
+    the wider tile's intrinsic win over the 4 GB fused variant.  The
+    two-kernel fallback at bk=2048 is UNMEASURED and keeps the flat
+    default.  At 16k the flip is jitter-neutral, so the default grows
+    only at 32k+ where the win is measured.  MPIT_FA_LONG_BK_BWD=0 pins
+    the flat default.  block_q stays 1024 in the backward (2048x2048
+    measured far slower — the backward holds more live tiles per
+    program)."""
     dq, dk = _default_blocks(dtype)
     if (fwd_long_bq and block_q is None and lq >= 16384
             and jnp.dtype(dtype).itemsize <= 2
             and os.environ.get("MPIT_FA_LONG_BQ", "1") != "0"):
         dq = 2048
+    if (bwd_long_bk and block_k is None and lk >= 32768
+            and jnp.dtype(dtype).itemsize <= 2
+            and os.environ.get("MPIT_FA_LONG_BK_BWD", "1") != "0"):
+        dk = 2048
     block_q = dq if block_q is None else block_q
     block_k = dk if block_k is None else block_k
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -346,11 +373,7 @@ def _fa_2d(q, k, v, q_offset, kv_offset, *, causal, sm_scale, block_q,
     kp = jnp.pad(k, ((0, lk_p - lk), (0, d_p - d)))
     vp = jnp.pad(v, ((0, lk_p - lk), (0, d_p - d)))
     grid = (lq_p // bq, lk_p // bk)
-    # The (bq, bk) f32 score tile at bq=2048 (8 MB) cannot compile under
-    # the stock scoped-VMEM budget; request the 64 MB budget measured
-    # perf-neutral for every tile geometry (docs/tpu_compile_notes.md §2)
-    # whenever the resolved tile needs it.
-    vmem_auto = 64.0 if bq * bk * 4 > 4 * 2**20 else 0.0
+    vmem_auto = _vmem_auto(bq, bk)
 
     sspec = pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
     qspec = pl.BlockSpec((bq, d_p), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
@@ -646,8 +669,13 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
     """
     lq, d = q.shape
     lk = k.shape[0]
+    # bwd_long_bk only under the fused schedule: the 32k sweep measured
+    # the win THERE (the halved dQ-partials transient is most of it);
+    # the two-kernel schedule with bk=2048 is unmeasured, so the
+    # fallback keeps its flat default.  _use_fused_bwd models the fused
+    # candidate with the same flag, so gate and kernel stay consistent.
     scale, bq, bk, lq_p, lk_p, d_p = _tile_dims(
-        lq, lk, d, block_q, block_k, sm_scale, q.dtype
+        lq, lk, d, block_q, block_k, sm_scale, q.dtype, bwd_long_bk=fused
     )
     qp = jnp.pad(q, ((0, lq_p - lq), (0, d_p - d)))
     kp = jnp.pad(k, ((0, lk_p - lk), (0, d_p - d)))
@@ -655,6 +683,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
     dop = jnp.pad(do, ((0, lq_p - lq), (0, d_p - d)))
     lse_r = _rows_to_lanes(lse, lq_p)
     delta_r = _rows_to_lanes(delta, lq_p)
+    vmem_auto = _vmem_auto(bq, bk)
 
     sspec = pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
     scalars = (
@@ -703,7 +732,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
                 pltpu.VMEM((bk, d_p), jnp.float32),
             ],
             interpret=interp,
-            compiler_params=_fa_compiler_params(),
+            compiler_params=_fa_compiler_params(vmem_auto),
         )(*scalars, kp, vp, qp, dop, lse_r, delta_r)
         dq = jnp.sum(dq_part, axis=0).astype(q.dtype)
         return dq[:lq, :d], dk[:lk, :d], dv[:lk, :d]
@@ -721,7 +750,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
         out_shape=jax.ShapeDtypeStruct((lq_p, d_p), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
         interpret=interp,
-        compiler_params=_fa_compiler_params(),
+        compiler_params=_fa_compiler_params(vmem_auto),
     )(*scalars, qp, dop, lse_r, delta_r, kp, vp)
 
     # Kernel 2: dK/dV — kv blocks outer, q rows inner.
@@ -743,7 +772,7 @@ def _fa_2d_bwd(q, k, v, do, lse, delta, q_offset, kv_offset, *, causal,
             pltpu.VMEM((bk, d_p), jnp.float32),
         ],
         interpret=interp,
-        compiler_params=_fa_compiler_params(),
+        compiler_params=_fa_compiler_params(vmem_auto),
     )(*scalars, kp, vp, qp, dop, lse_r, delta_r)
 
     return dq[:lq, :d], dk[:lk, :d], dv[:lk, :d]
@@ -761,10 +790,13 @@ def _use_fused_bwd(q_shape, k_shape, d, dtype, sm_scale, block_q, block_k):
     2 of 7 matmuls per tile pair; the round-5 on-chip A/B
     (docs/KERNEL_BENCH.md §0.6) measured it faster at every length
     (-5.5% at 8k, -5.7% at 16k, -7.0% at 32k on the B=1 H=8 D=128
-    bench shape), so the budget is sized to admit the 1 GB transient at 16k
-    and refuse the 4 GB one at 32k — the kernel-level win there is not
-    worth an OOM risk inside composite training programs; raise the
-    budget for pure-attention workloads with HBM to spare."""
+    bench shape).  The budget admits the 1 GB transient at 16k and
+    refuses 4 GB; at 32k the length-aware bwd bk=2048 default (§0.5
+    sweep: fwd+bwd 74 -> 63-67 ms) halves the transient to exactly
+    2048 MB, so the bench shape now runs FUSED at 32k by default —
+    shave ``MPIT_FA_FUSED_BWD_MAX_MB`` (or set
+    ``MPIT_FA_LONG_BK_BWD=0``) to force the two-kernel schedule when a
+    composite program needs the HBM back."""
     mode = os.environ.get("MPIT_FA_FUSED_BWD", "auto") or "auto"
     if mode == "0":
         return False
@@ -778,8 +810,11 @@ def _use_fused_bwd(q_shape, k_shape, d, dtype, sm_scale, block_q, block_k):
             f"MPIT_FA_FUSED_BWD={mode!r}: expected '0', '1', or 'auto'"
         )
     lq, lk = q_shape[-2], k_shape[-2]
+    # bwd_long_bk: the gate must see the SAME bk the executed backward
+    # resolves (_fa_2d_bwd), or the transient estimate is for a
+    # different schedule than the one that runs.
     _, _, bk, lq_p, lk_p, d_p = _tile_dims(
-        lq, lk, d, block_q, block_k, sm_scale, dtype
+        lq, lk, d, block_q, block_k, sm_scale, dtype, bwd_long_bk=True
     )
     batch = 1
     for s in q_shape[:-2]:

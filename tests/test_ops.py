@@ -150,19 +150,22 @@ def test_flash_offsets_pallas(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(full[C:]), atol=2e-5)
 
 
-def _assert_flash_grads_match(q, k, v):
+def _assert_flash_grads_match(q, k, v, fa=None, atol=3e-5):
     """Shared grad check: squared-sum loss through the pallas path vs the
-    dense reference, 3e-5 atol (the ONE place the loss/tolerance live)."""
-    fa = lambda q, k, v: jnp.sum(
-        flash_attention(q, k, v, causal=True, block_q=8, block_k=128) ** 2
-    )
+    dense reference, 3e-5 atol (the ONE place the loss/tolerance live).
+    ``fa`` overrides the attention callable (default: tiny blocks)."""
+    if fa is None:
+        import functools
+
+        fa = functools.partial(flash_attention, block_q=8, block_k=128)
+    fa_loss = lambda q, k, v: jnp.sum(fa(q, k, v, causal=True) ** 2)
     ref = lambda q, k, v: jnp.sum(
         attention_reference(q, k, v, causal=True) ** 2
     )
-    for a, b in zip(jax.grad(fa, argnums=(0, 1, 2))(q, k, v),
+    for a, b in zip(jax.grad(fa_loss, argnums=(0, 1, 2))(q, k, v),
                     jax.grad(ref, argnums=(0, 1, 2))(q, k, v)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
-    return fa, ref
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=atol)
+    return fa_loss, ref
 
 
 @pytest.fixture
@@ -207,6 +210,58 @@ def test_fwd_long_bq_block_routing(monkeypatch):
     assert bq_of(32768, dtype=jnp.float32) == 512   # f32 path untouched
 
 
+def test_bwd_long_bk_block_routing(monkeypatch):
+    """Backward default block_k grows to 2048 at Lk >= 32768 bf16 (the
+    32k sweep's winner, KERNEL_BENCH §0.5) — and the fused-schedule gate
+    resolves the SAME bk, so its dQ-partials transient estimate matches
+    the schedule that actually runs (2 GB at 32k on the bench shape,
+    admitted by the 2048 MB budget)."""
+    from mpit_tpu.ops.flash_attention import _tile_dims, _use_fused_bwd
+
+    def bk_of(lk, dtype=jnp.bfloat16, block_k=None, **env):
+        for kk, vv in env.items():
+            monkeypatch.setenv(kk, vv)
+        out = _tile_dims(lk, lk, 128, None, block_k, None, dtype,
+                         bwd_long_bk=True)
+        monkeypatch.delenv("MPIT_FA_LONG_BK_BWD", raising=False)
+        return out[2]
+
+    assert bk_of(16384) == 1024          # jitter-neutral length: flat
+    assert bk_of(32768) == 2048          # measured winner
+    assert bk_of(32768, block_k=1024) == 1024        # explicit wins
+    assert bk_of(32768, MPIT_FA_LONG_BK_BWD="0") == 1024
+    assert bk_of(32768, dtype=jnp.float32) == 512
+
+    # Gate/kernel agreement at 32k: bk=2048 -> 16 kv blocks -> exactly
+    # 2048 MB on the (1, 8) x 32k x 128 bench shape -> fused admitted.
+    monkeypatch.delenv("MPIT_FA_FUSED_BWD", raising=False)
+    monkeypatch.delenv("MPIT_FA_FUSED_BWD_MAX_MB", raising=False)
+    args32 = ((1, 8, 32768, 128), (1, 8, 32768, 128), 128, jnp.bfloat16,
+              None, None, None)
+    assert _use_fused_bwd(*args32) is True
+    # The kill-switch restores the flat bk -> 4 GB -> two-kernel.
+    monkeypatch.setenv("MPIT_FA_LONG_BK_BWD", "0")
+    assert _use_fused_bwd(*args32) is False
+
+
+@pytest.mark.parametrize("fa_backward_path", ["1", "0"], indirect=True,
+                         ids=["fused-bwd", "two-kernel-bwd"])
+@pytest.mark.parametrize("blocks", [(1024, 2048)])
+def test_flash_grad_matches_reference_wide_bk(rng, blocks, fa_backward_path):
+    """Multi-block bk=2048 geometry (the long-L backward default),
+    exercised in interpret mode at a size with >=2 kv blocks per grid —
+    small-shape grad tests clamp blocks and never see this shape."""
+    bq, bk = blocks
+    L = 4096
+    q, k, v = _qkv(rng, (L, 64))
+
+    import functools
+    from mpit_tpu.ops import flash_attention
+
+    fa = functools.partial(flash_attention, block_q=bq, block_k=bk)
+    _assert_flash_grads_match(q, k, v, fa=fa)
+
+
 def test_fused_bwd_auto_gate(monkeypatch):
     """The auto mode picks the fused sweep only while the dQ-partials
     transient (batch x n_kv_blocks x Lq_p x D_p f32) fits the budget."""
@@ -226,11 +281,18 @@ def test_fused_bwd_auto_gate(monkeypatch):
     args16 = ((1, 8, 16384, 128), (1, 8, 16384, 128), 128, jnp.bfloat16,
               None, None, None)
     assert _use_fused_bwd(*args16) is True
-    # 32k: 32 * 32768 * 128 * 4 x 8 heads = 4 GB >> default budget.
+    # 32k: the length-aware backward default bk=2048 (16 kv blocks)
+    # puts the transient at exactly 2048 MB -> admitted; pinning the
+    # flat bk=1024 (32 blocks, 4 GB) or shaving the budget refuses it.
     args32 = ((1, 8, 32768, 128), (1, 8, 32768, 128), 128, jnp.bfloat16,
               None, None, None)
     monkeypatch.delenv("MPIT_FA_FUSED_BWD_MAX_MB", raising=False)
+    assert _use_fused_bwd(*args32) is True
+    monkeypatch.setenv("MPIT_FA_FUSED_BWD_MAX_MB", "2047")
     assert _use_fused_bwd(*args32) is False
+    monkeypatch.delenv("MPIT_FA_FUSED_BWD_MAX_MB", raising=False)
+    args32_flat = args32[:-1]
+    assert _use_fused_bwd(*args32_flat, 1024) is False
     # The explicit levers stay unconditional.
     monkeypatch.setenv("MPIT_FA_FUSED_BWD", "1")
     assert _use_fused_bwd(*args32) is True
