@@ -155,9 +155,15 @@ def bench_torch_cpu() -> float:
     from mpit_tpu.data.mnist import load_mnist
     from mpit_tpu.train.mesh_launch import FLAGSHIP_BENCH_KWARGS
 
-    # The torch leg must mirror the jax leg's workload shape exactly.
-    assert FLAGSHIP_BENCH_KWARGS["batch"] == BATCH
-    assert FLAGSHIP_BENCH_KWARGS["side"] == SIDE
+    # The torch leg must mirror the jax leg's workload shape exactly —
+    # raise, not assert: python -O would compile an assert away and the
+    # torch leg would silently time a different workload.
+    if (FLAGSHIP_BENCH_KWARGS["batch"] != BATCH
+            or FLAGSHIP_BENCH_KWARGS["side"] != SIDE):
+        raise ValueError(
+            "torch baseline shape drifted from FLAGSHIP_BENCH_KWARGS: "
+            f"batch {FLAGSHIP_BENCH_KWARGS['batch']} vs {BATCH}, "
+            f"side {FLAGSHIP_BENCH_KWARGS['side']} vs {SIDE}")
 
     (x_train, y_train, _, _), _src = load_mnist(side=SIDE)
     torch.manual_seed(0)

@@ -5,8 +5,10 @@ argument (5 vs 7 per tile pair) without an on-chip measurement; the
 round-4 verdict requires the numbers — wall time AND peak HBM, with the
 dQ-partials transient accounted across the vmapped B*H axis
 (`ops/flash_attention.py` fused branch: an (n_kv_blocks, Lq, D) f32
-buffer per (B, H) program — 512 MB/head at L=32k with 1024-wide kv
-blocks) — before any more claims stack on the default.
+buffer per (B, H) program — with the round-5 length-aware backward
+default, 2048-wide kv blocks at 32k make that 256 MB/head; the
+analytic column resolves bk through the same default the kernel uses)
+— before any more claims stack on the default.
 
 Each (schedule, L) combo runs in a FRESH SUBPROCESS: jax exposes only a
 process-cumulative ``peak_bytes_in_use``, so per-variant peaks must not
@@ -123,12 +125,22 @@ def main() -> None:
                                     f"{r.stderr[-300:]}"}
             # The analytic transient the fused path pays: one
             # (n_kv_blocks, Lq, D) f32 partial buffer per (B, H)
-            # program, all live at once under vmap.
+            # program, all live at once under vmap.  Resolve bk through
+            # the SAME length-aware default the fused kernel uses
+            # (bwd_long_bk: 2048 at 32k+) so the analytic row describes
+            # the schedule that actually ran.
             if mode == "fused":
-                bk = 1024 if L >= 1024 else L  # bf16 default kv block
+                import jax.numpy as _jnp
+
+                from mpit_tpu.ops.flash_attention import _tile_dims
+
+                _, _, bk, lq_p, _, d_p = _tile_dims(
+                    L, L, D, None, None, None, _jnp.bfloat16,
+                    bwd_long_bk=True)
                 nj = -(-L // bk)
+                rec["bwd_block_k"] = bk
                 rec["dq_partials_mb_analytic"] = round(
-                    B * H * nj * L * D * 4 / 2**20, 1)
+                    B * H * nj * lq_p * d_p * 4 / 2**20, 1)
                 # What the SHIPPING default (MPIT_FA_FUSED_BWD=auto)
                 # chooses at this shape — so the aggregate record shows
                 # whether each measured row is the default path.

@@ -796,7 +796,14 @@ def _use_fused_bwd(q_shape, k_shape, d, dtype, sm_scale, block_q, block_k):
     2048 MB, so the bench shape now runs FUSED at 32k by default —
     shave ``MPIT_FA_FUSED_BWD_MAX_MB`` (or set
     ``MPIT_FA_LONG_BK_BWD=0``) to force the two-kernel schedule when a
-    composite program needs the HBM back."""
+    composite program needs the HBM back.
+
+    Caveat: the batch factor comes from ``q_shape[:-2]``, i.e. the
+    shape :func:`flash_attention` itself receives.  Pass the full
+    batched array and let the op vmap internally (as the model zoo
+    does); wrapping the op in an OUTER ``jax.vmap`` batches the
+    custom-vjp rules per example, so this gate sees a batch of 1 and
+    undercounts the transient by the outer batch factor."""
     mode = os.environ.get("MPIT_FA_FUSED_BWD", "auto") or "auto"
     if mode == "0":
         return False

@@ -17,7 +17,6 @@ docqa,flagship; default both), MPIT_ACC_OUT (JSON-lines file).
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time
@@ -26,6 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from benchmarks._common import emit_json, log as _log
 from mpit_tpu.utils.platform import honor_jax_platforms
 
 honor_jax_platforms()
@@ -33,10 +33,6 @@ honor_jax_platforms()
 SEEDS = [int(s) for s in os.environ.get("MPIT_ACC_SEEDS", "0,1,2").split(",")]
 LEGS = os.environ.get("MPIT_ACC_LEGS", "docqa,flagship").split(",")
 OUT = os.environ.get("MPIT_ACC_OUT", "")
-
-
-def _log(*a):
-    print(*a, file=sys.stderr, flush=True)
 
 
 def _stats(xs):
@@ -101,11 +97,7 @@ def main():
     recs = []
     for leg in [s.strip() for s in LEGS if s.strip()]:
         recs.append(known[leg]())
-        line = json.dumps(recs[-1])
-        print(line)
-        if OUT:
-            with open(OUT, "a") as fh:
-                fh.write(line + "\n")
+        emit_json(recs[-1], OUT)
     # Markdown table for the north-star doc.
     _log("\n| leg | metric | median | runs (seeds " +
          ",".join(map(str, SEEDS)) + ") | spread |")
