@@ -1,0 +1,33 @@
+"""mtlint — framework-aware static analysis for mpit_tpu.
+
+Three rule families keep the invariants that used to live only in
+prose machine-checked on every tier-1 run:
+
+- **protocol** (MT-P1xx): PS wire-protocol conformance — tag pairing
+  across the client/server roles, ``*_ACK`` write tails, request/reply
+  deadlock shapes, and comm/native spec drift;
+- **concurrency** (MT-C2xx): lock-order inversions, blocking calls
+  under a lock, and scheduler yields inside lock regions;
+- **jax** (MT-J3xx): host-device syncs and Python branches on traced
+  values inside jitted functions, and update steps missing
+  ``donate_argnums``.
+
+Run ``python tools/mtlint.py mpit_tpu/`` (or the ``mtlint`` console
+entry).  The checked-in ``mtlint.toml`` baseline carries the vetted
+suppressions; see docs/ANALYSIS.md for the rule catalog.
+"""
+
+from mpit_tpu.analysis.config import Config, Suppression, discover_config, load_config
+from mpit_tpu.analysis.core import RULES, Finding
+from mpit_tpu.analysis.engine import Report, run
+
+__all__ = [
+    "Config",
+    "Finding",
+    "Report",
+    "RULES",
+    "Suppression",
+    "discover_config",
+    "load_config",
+    "run",
+]

@@ -1,0 +1,167 @@
+"""Concurrency rules (MT-C2xx) — lock discipline and the scheduler
+yield contract.
+
+Lock regions are ``with <expr>:`` statements whose context expression
+*names* a lock (``lock`` / ``mutex`` / ``cv`` / ``cond`` in the source
+text — the naming convention of comm/tcp.py, comm/local.py and the
+native build serializer).  Within them:
+
+- **MT-C201** — the per-file lock-*order* graph (edges from every held
+  lock to each newly acquired one, subscripts normalized so
+  ``self._out_cv[peer]`` and ``self._out_cv[dst]`` are one lock class)
+  must be acyclic between pairs: an A->B edge with a B->A edge
+  elsewhere in the same file is an inversion, flagged at both sites.
+- **MT-C202** — blocking calls (socket recv*/accept/connect/sendall,
+  thread join, time.sleep, jax block_until_ready, subprocess run
+  helpers) must not run while a lock is held; ``Condition.wait``
+  releases its lock and is exempt by design.
+- **MT-C203** — a generator must never ``yield`` from inside a lock
+  region: on the cooperative scheduler the task is parked mid-step
+  *still holding the lock*, and any other task (or transport thread)
+  that needs it deadlocks the role process.  Nested defs reset the
+  held-set — their bodies run later, not under the enclosing lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from mpit_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    callee_name,
+    iter_functions,
+    root_name,
+)
+
+_LOCK_NAME = re.compile(r"lock|mutex|cv|cond", re.IGNORECASE)
+
+#: attribute / name callees that block the calling thread outright.
+_BLOCKING_ATTRS = {
+    "recv", "recv_into", "recvfrom", "recvmsg", "accept", "connect",
+    "sendall", "sleep", "block_until_ready",
+}
+#: subprocess helpers — blocking only when called off the subprocess module.
+_SUBPROCESS_ATTRS = {"run", "call", "check_call", "check_output", "communicate"}
+
+
+def _lock_id(expr: ast.AST) -> Optional[str]:
+    """Normalized lock identity for a with-item, or None when the
+    expression does not look like a lock."""
+    try:
+        src = ast.unparse(expr)
+    except Exception:  # pragma: no cover - unparse is total on 3.10 asts
+        return None
+    if isinstance(expr, ast.Call):
+        # `with self._make_ctx():` — context factories (nullcontext,
+        # jax.default_device, ...) are not lock acquisitions even when
+        # their name happens to contain a lock-ish substring.
+        return None
+    if not _LOCK_NAME.search(src):
+        return None
+    # One lock *class* per container: self._out_cv[peer] == self._out_cv[dst].
+    return re.sub(r"\[[^\]]*\]", "[*]", src)
+
+
+def _is_blocking(call: ast.Call) -> bool:
+    name = callee_name(call)
+    if name == "join":
+        # Thread/process join blocks; str.join / os.path.join do not.
+        if isinstance(call.func, ast.Attribute):
+            recv = call.func.value
+            if isinstance(recv, (ast.Constant, ast.JoinedStr)):
+                return False
+            if root_name(call.func) in ("os", "posixpath", "ntpath", "str"):
+                return False
+        return True
+    if name in _BLOCKING_ATTRS:
+        return True
+    if name in _SUBPROCESS_ATTRS and root_name(call.func) == "subprocess":
+        return True
+    return False
+
+
+@dataclass
+class _Edge:
+    outer: str
+    inner: str
+    src: SourceFile
+    line: int
+    qual: str
+
+
+def _scan_function(src: SourceFile, qual: str, fn: ast.AST,
+                   edges: List[_Edge], findings: List[Finding]) -> None:
+    def visit(node: ast.AST, held: List[Tuple[str, int]]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested bodies run later, outside this region
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                lock = _lock_id(item.context_expr)
+                if lock is None:
+                    continue
+                for outer, _ in held + acquired:
+                    if outer != lock:
+                        edges.append(_Edge(
+                            outer=outer, inner=lock, src=src,
+                            line=node.lineno, qual=qual))
+                acquired.append((lock, node.lineno))
+            for sub in node.body:
+                visit(sub, held + acquired)
+            return
+        if held:
+            if isinstance(node, ast.Call) and _is_blocking(node):
+                lock, lline = held[-1]
+                findings.append(src.finding(
+                    "MT-C202", node,
+                    f"{qual} calls {ast.unparse(node.func)}() while holding "
+                    f"{lock} (acquired line {lline}) — the lock is pinned "
+                    "for the call's full blocking duration"))
+            if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                lock, lline = held[-1]
+                findings.append(src.finding(
+                    "MT-C203", node,
+                    f"{qual} yields to the scheduler while holding {lock} "
+                    f"(acquired line {lline}) — the parked task wedges "
+                    "every other task that needs the lock"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for child in ast.iter_child_nodes(fn):
+        visit(child, [])
+
+
+def check(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        edges: List[_Edge] = []
+        for qual, fn in iter_functions(src.tree):
+            _scan_function(src, qual, fn, edges, findings)
+        # MT-C201 — pairwise inversions within one file (lock identities
+        # are only comparable inside a file: two classes may both name a
+        # lock ``self._lock`` without ever sharing it).
+        pairs: Dict[Tuple[str, str], List[_Edge]] = {}
+        for e in edges:
+            pairs.setdefault((e.outer, e.inner), []).append(e)
+        reported = set()
+        for (a, b), sites in sorted(pairs.items()):
+            if (b, a) not in pairs or a == b:
+                continue
+            for e in sites:
+                key = (a, b, e.line)
+                if key in reported:
+                    continue
+                reported.add(key)
+                other = pairs[(b, a)][0]
+                findings.append(src.finding(
+                    "MT-C201", e.line,
+                    f"{e.qual} acquires {b} while holding {a}, but "
+                    f"{other.qual} (line {other.line}) acquires {a} while "
+                    f"holding {b} — two threads taking the locks in "
+                    "opposite order deadlock"))
+    return findings

@@ -1,0 +1,192 @@
+"""JAX hot-path rules (MT-J3xx) — keep jitted step functions on-device.
+
+A function is considered **jitted** when it is decorated with
+``jax.jit`` / ``jax.pmap`` (directly or through ``functools.partial``),
+or when a module-local ``jax.jit(f)`` / ``jit(f)`` call wraps it by
+name; lambdas passed straight into ``jit`` are scanned as jitted
+bodies too.  Inside a jitted body:
+
+- **MT-J301** — host-device syncs: ``float(x)`` / ``int(x)`` on a
+  non-literal, ``np.asarray``/``np.array``/``np.frombuffer`` off the
+  ``np``/``numpy`` module, ``.item()``, and ``.block_until_ready()``.
+  Under trace these either fail (`TracerConversionError`) at an
+  untested branch or silently force a device sync per step.
+- **MT-J302** — an ``if``/``while`` whose test calls into
+  ``jnp``/``jax.lax`` operates on a traced value: the Python branch
+  forces concretization (a sync + retrace hazard) instead of
+  ``jnp.where``/``lax.cond``.
+
+At every jit *call site* (decorator or wrap):
+
+- **MT-J303** — an update/step-shaped function (name matching
+  ``update|step|train|apply``) jitted without ``donate_argnums`` /
+  ``donate_argnames`` reallocates its parameter buffers every step —
+  on TPU that doubles the hot loop's HBM traffic for the updated state.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from mpit_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    callee_name,
+    iter_functions,
+    root_name,
+)
+
+_JIT_NAMES = {"jit", "pmap"}
+_NP_ROOTS = {"np", "numpy", "onp"}
+_NP_SYNC_ATTRS = {"asarray", "array", "frombuffer", "copy"}
+_UPDATE_NAME = re.compile(r"update|step|train|apply", re.IGNORECASE)
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """True for ``jit`` / ``jax.jit`` / ``jax.pmap`` references."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+@dataclass
+class _JitSite:
+    node: ast.AST  # the jit Call (or decorator) node, for the report line
+    wrapped_name: Optional[str]  # terminal name of the wrapped callable
+    has_donate: bool
+
+
+def _decorator_jit_site(fn: ast.FunctionDef) -> Optional[_JitSite]:
+    for dec in fn.decorator_list:
+        if _is_jit_ref(dec):
+            return _JitSite(dec, fn.name, has_donate=False)
+        if isinstance(dec, ast.Call):
+            if _is_jit_ref(dec.func):
+                donate = any(kw.arg in _DONATE_KWARGS for kw in dec.keywords)
+                return _JitSite(dec, fn.name, donate)
+            if (callee_name(dec) == "partial" and dec.args
+                    and _is_jit_ref(dec.args[0])):
+                donate = any(kw.arg in _DONATE_KWARGS for kw in dec.keywords)
+                return _JitSite(dec, fn.name, donate)
+    return None
+
+
+def _wrapped_name(arg: ast.AST) -> Optional[str]:
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Attribute):
+        return arg.attr
+    if isinstance(arg, ast.Call):
+        return callee_name(arg)
+    return None
+
+
+def _call_jit_sites(tree: ast.Module):
+    """Yield (_JitSite, wrapped ast node) for every jit(...) call."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and _is_jit_ref(node.func)
+                and node.args):
+            donate = any(kw.arg in _DONATE_KWARGS for kw in node.keywords)
+            yield _JitSite(node, _wrapped_name(node.args[0]), donate), node.args[0]
+
+
+def _jitted_bodies(src: SourceFile):
+    """Yield (qualname, body node) for every region traced under jit."""
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    for qual, fn in iter_functions(src.tree):
+        defs.setdefault(fn.name, []).append(fn)
+
+    seen: Set[int] = set()
+    for qual, fn in iter_functions(src.tree):
+        if _decorator_jit_site(fn) is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            yield qual, fn
+    for site, wrapped in _call_jit_sites(src.tree):
+        if isinstance(wrapped, ast.Lambda):
+            yield f"<lambda:{wrapped.lineno}>", wrapped
+        elif isinstance(wrapped, ast.Name):
+            for fn in defs.get(wrapped.id, []):
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    yield fn.name, fn
+
+
+def _check_body(src: SourceFile, qual: str, body: ast.AST) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(body):
+        if isinstance(node, ast.Call):
+            name = callee_name(node)
+            if (isinstance(node.func, ast.Name) and name in ("float", "int")
+                    and node.args
+                    and not isinstance(node.args[0], ast.Constant)):
+                findings.append(src.finding(
+                    "MT-J301", node,
+                    f"{qual} calls {name}() on a traced value — under jit "
+                    "this is a host sync (or a TracerConversionError); keep "
+                    "the value on-device or hoist it to a static argument"))
+            elif (name in _NP_SYNC_ATTRS
+                  and isinstance(node.func, ast.Attribute)
+                  and root_name(node.func) in _NP_ROOTS):
+                findings.append(src.finding(
+                    "MT-J301", node,
+                    f"{qual} calls {ast.unparse(node.func)}() inside a "
+                    "jitted function — numpy materializes on host; use jnp"))
+            elif name in ("item", "block_until_ready") and isinstance(
+                    node.func, ast.Attribute):
+                findings.append(src.finding(
+                    "MT-J301", node,
+                    f"{qual} calls .{name}() inside a jitted function — "
+                    "a forced device->host sync on the hot path"))
+        elif isinstance(node, (ast.If, ast.While)):
+            if _test_is_traced(node.test):
+                findings.append(src.finding(
+                    "MT-J302", node,
+                    f"{qual} branches in Python on a traced expression "
+                    f"({ast.unparse(node.test)}) — use jnp.where or "
+                    "lax.cond; a Python branch concretizes the tracer"))
+    return findings
+
+
+def _test_is_traced(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            root = root_name(node.func)
+            if root in ("jnp", "lax") or (
+                    root == "jax" and "lax" in ast.unparse(node.func)):
+                return True
+    return False
+
+
+def check(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        checked: Set[Tuple[str, int]] = set()
+        for qual, body in _jitted_bodies(src):
+            key = (qual, body.lineno)
+            if key in checked:
+                continue
+            checked.add(key)
+            findings.extend(_check_body(src, qual, body))
+
+        # MT-J303 — donation at the jit site.
+        sites = [s for s, _ in _call_jit_sites(src.tree)]
+        for _, fn in iter_functions(src.tree):
+            site = _decorator_jit_site(fn)
+            if site is not None:
+                sites.append(site)
+        for site in sites:
+            if site.has_donate or not site.wrapped_name:
+                continue
+            if _UPDATE_NAME.search(site.wrapped_name):
+                findings.append(src.finding(
+                    "MT-J303", site.node,
+                    f"jit of update-shaped function {site.wrapped_name!r} "
+                    "without donate_argnums/donate_argnames — the updated "
+                    "buffers are reallocated every step instead of reused"))
+    return findings

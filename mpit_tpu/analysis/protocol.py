@@ -1,0 +1,348 @@
+"""Protocol-conformance rules (MT-P1xx) — the PS wire protocol, checked.
+
+The contract lives in prose today: ps/tags.py documents which direction
+each tag flows, which writes carry a 0-byte ``*_ACK`` tail, and which
+0-byte headers precede a read (the reference's pclient/pserver
+rendezvous conventions).  This pass makes it machine-checked:
+
+- the **tag table** is any module named ``tags.py`` whose module-level
+  ``NAME = <int>`` assignments define the channels;
+- **role files** are modules whose stem contains ``client`` or
+  ``server``; every ``aio_send``/``aio_recv`` and transport-level
+  ``isend``/``irecv``/``iprobe`` call site is extracted with its tag
+  (attribute ``tags.X``, bare imported name, keyword ``tag=``, or a
+  literal int reverse-mapped through the table);
+- a per-role send/recv graph is checked for: tags nobody uses
+  (MT-P101), sends with no peer-role recv and recvs with no peer-role
+  send (MT-P102), write tags whose ``*_ACK`` tail is missing in the
+  same function (MT-P103), and request/reply cycles where both roles
+  block on recv before their own send — the deadlock shape (MT-P104);
+- ``comm/native/specs/*.json`` is checked against the checked-in
+  generated bindings by re-running the (stdlib-only) generator and
+  comparing output — spec drift is MT-P105.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import pathlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from mpit_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    callee_name,
+    iter_functions,
+)
+
+#: callee name -> (op kind, index of the positional tag argument)
+_TAG_CALLS = {
+    "aio_send": ("send", 3),
+    "isend": ("send", 2),
+    "aio_recv": ("recv", 2),
+    "irecv": ("recv", 1),
+    "iprobe": ("recv", 1),
+}
+
+
+@dataclass
+class ProtoOp:
+    kind: str  # "send" | "recv"
+    tag: str  # tag-table name
+    line: int
+
+
+@dataclass
+class RoleFn:
+    """One function in a role file, with its tag ops in source order."""
+    role: str
+    qual: str
+    src: SourceFile
+    ops: List[ProtoOp]
+
+    def sends(self, tag: str) -> List[ProtoOp]:
+        return [op for op in self.ops if op.kind == "send" and op.tag == tag]
+
+    def recvs(self, tag: str) -> List[ProtoOp]:
+        return [op for op in self.ops if op.kind == "recv" and op.tag == tag]
+
+
+def _load_tag_table(files: List[SourceFile]):
+    """Merge every tags.py module-level ``NAME = int`` into one table."""
+    table: Dict[str, int] = {}
+    lines: Dict[str, Tuple[SourceFile, int]] = {}
+    for src in files:
+        if src.path.stem != "tags":
+            continue
+        for node in src.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)
+                    and not isinstance(node.value.value, bool)):
+                name = node.targets[0].id
+                table[name] = node.value.value
+                lines[name] = (src, node.lineno)
+    return table, lines
+
+
+def _role_of(src: SourceFile) -> Optional[str]:
+    stem = src.path.stem.lower()
+    if "client" in stem:
+        return "client"
+    if "server" in stem:
+        return "server"
+    return None
+
+
+def _tag_of(node: ast.AST, table: Dict[str, int]) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and node.attr in table:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in table:
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        for name, value in table.items():
+            if value == node.value:
+                return name
+    return None
+
+
+def _collect_role_fns(files: List[SourceFile], table) -> List[RoleFn]:
+    fns: List[RoleFn] = []
+    for src in files:
+        role = _role_of(src)
+        if role is None:
+            continue
+        for qual, node in iter_functions(src.tree):
+            ops = _extract_ops_shallow(node, table)
+            if ops:
+                fns.append(RoleFn(role=role, qual=qual, src=src, ops=ops))
+    return fns
+
+
+def _extract_ops_shallow(fn: ast.AST, table) -> List[ProtoOp]:
+    """Like _extract_ops but without descending into nested defs —
+    a nested generator's ops belong to the nested function."""
+    ops: List[ProtoOp] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                ops.extend(_extract_ops_call(child, table))
+            walk(child)
+
+    walk(fn)
+    ops.sort(key=lambda op: op.line)
+    return ops
+
+
+def _extract_ops_call(node: ast.Call, table) -> List[ProtoOp]:
+    name = callee_name(node)
+    if name not in _TAG_CALLS:
+        return []
+    kind, tag_idx = _TAG_CALLS[name]
+    tag_node: Optional[ast.AST] = None
+    for kw in node.keywords:
+        if kw.arg == "tag":
+            tag_node = kw.value
+    if tag_node is None and len(node.args) > tag_idx:
+        tag_node = node.args[tag_idx]
+    tag = _tag_of(tag_node, table) if tag_node is not None else None
+    if tag is None:
+        return []
+    return [ProtoOp(kind=kind, tag=tag, line=node.lineno)]
+
+
+_PEER = {"client": "server", "server": "client"}
+
+
+def _check_pairing(table, tag_lines, fns: List[RoleFn]) -> List[Finding]:
+    findings: List[Finding] = []
+    used: set = set()
+    by_role: Dict[str, List[RoleFn]] = {"client": [], "server": []}
+    for fn in fns:
+        by_role[fn.role].append(fn)
+        for op in fn.ops:
+            used.add(op.tag)
+
+    # MT-P101: tag in the table, never used by any role.
+    for name, (src, line) in sorted(tag_lines.items()):
+        if name not in used:
+            findings.append(src.finding(
+                "MT-P101", line,
+                f"tag {name} is defined but no client/server send or recv "
+                "references it"))
+
+    # MT-P102: every (role, kind, tag) must have the complementary op in
+    # the peer role.  Reported once per (role, kind, tag) at first use.
+    peer_ops: Dict[Tuple[str, str], set] = {}
+    for fn in fns:
+        for op in fn.ops:
+            peer_ops.setdefault((fn.role, op.kind), set()).add(op.tag)
+    seen: set = set()
+    for fn in fns:
+        for op in fn.ops:
+            key = (fn.role, op.kind, op.tag)
+            if key in seen:
+                continue
+            seen.add(key)
+            peer = _PEER[fn.role]
+            want = "recv" if op.kind == "send" else "send"
+            if op.tag not in peer_ops.get((peer, want), set()):
+                verb = "sends" if op.kind == "send" else "receives"
+                findings.append(fn.src.finding(
+                    "MT-P102", op.line,
+                    f"{fn.role} {verb} tag {op.tag} but the {peer} role has "
+                    f"no matching {want} — one side of this channel is "
+                    "unimplemented"))
+    return findings
+
+
+def _write_tags(table) -> Dict[str, str]:
+    """tag -> its ack tag, for every T with a T_ACK in the table."""
+    return {t: f"{t}_ACK" for t in table
+            if not t.endswith("_ACK") and f"{t}_ACK" in table}
+
+
+def _check_ack_discipline(table, fns: List[RoleFn]) -> List[Finding]:
+    findings: List[Finding] = []
+    writes = _write_tags(table)
+    for fn in fns:
+        for op in fn.ops:
+            if op.tag not in writes:
+                continue
+            ack = writes[op.tag]
+            if fn.role == "client" and op.kind == "send":
+                # The writer must await the applied-ack before reusing
+                # the buffer / issuing dependent ops (0-byte tail).
+                if not any(a.line > op.line for a in fn.recvs(ack)):
+                    findings.append(fn.src.finding(
+                        "MT-P103", op.line,
+                        f"{fn.qual} sends write tag {op.tag} but never "
+                        f"receives its {ack} tail in the same function — "
+                        "the write completion is unobservable"))
+            elif fn.role == "server" and op.kind == "recv":
+                if not any(a.line > op.line for a in fn.sends(ack)):
+                    findings.append(fn.src.finding(
+                        "MT-P103", op.line,
+                        f"{fn.qual} receives write tag {op.tag} but never "
+                        f"sends its {ack} tail in the same function — the "
+                        "peer's blocking wait for the ack will hang"))
+    return findings
+
+
+def _check_deadlock_shape(fns: List[RoleFn]) -> List[Finding]:
+    """MT-P104: f (role A) blocks on recv(T) before sending U, while
+    every send of T in g (role B) happens only after g receives U —
+    a request/reply wait cycle with no initiator."""
+    findings: List[Finding] = []
+    for f in fns:
+        peers = [g for g in fns if g.role == _PEER[f.role]]
+        for r in (op for op in f.ops if op.kind == "recv"):
+            prior_sends = {op.tag for op in f.ops
+                           if op.kind == "send" and op.line < r.line}
+            for g in peers:
+                t_sends = g.sends(r.tag)
+                if not t_sends:
+                    continue
+                # Tags g must receive before it can possibly send T:
+                # intersect over all send sites (any unconditional send
+                # breaks the cycle).
+                required: Optional[set] = None
+                for s in t_sends:
+                    pre = {op.tag for op in g.ops
+                           if op.kind == "recv" and op.line < s.line}
+                    required = pre if required is None else required & pre
+                if not required:
+                    continue
+                for u in sorted(required):
+                    if u in prior_sends:
+                        continue
+                    later_send = [op for op in f.ops if op.kind == "send"
+                                  and op.tag == u and op.line > r.line]
+                    if later_send:
+                        findings.append(f.src.finding(
+                            "MT-P104", r.line,
+                            f"deadlock shape: {f.qual} blocks on recv({r.tag}) "
+                            f"before sending {u}, but {g.src.rel}:{g.qual} "
+                            f"sends {r.tag} only after receiving {u} — both "
+                            "roles wait on the other's send"))
+    return findings
+
+
+def _check_spec_drift(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        if src.path.name != "gen_bindings.py":
+            continue
+        spec_dir = src.path.parent / "specs"
+        bindings = src.path.parent / "_bindings.py"
+        if not spec_dir.is_dir() or not bindings.is_file():
+            continue
+        # Validate spec shape first (the generator would KeyError).
+        import json
+
+        bad = False
+        for spec_path in sorted(spec_dir.glob("*.json")):
+            try:
+                spec = json.loads(spec_path.read_text())
+            except ValueError as exc:
+                findings.append(Finding(
+                    "MT-P105", _rel_sibling(src, spec_path), 1,
+                    f"spec is not valid JSON: {exc}",
+                    abspath=spec_path.as_posix()))
+                bad = True
+                continue
+            missing = {"name", "ret", "args", "doc"} - set(spec)
+            if missing:
+                findings.append(Finding(
+                    "MT-P105", _rel_sibling(src, spec_path), 1,
+                    f"spec missing required keys {sorted(missing)}",
+                    abspath=spec_path.as_posix()))
+                bad = True
+        if bad:
+            continue
+        # The generator is stdlib-only (json + pathlib) and anchors on
+        # its own __file__, so loading it from the scanned tree and
+        # re-running it is safe and exact.
+        try:
+            spec_mod = importlib.util.spec_from_file_location(
+                "_mtlint_gen_bindings", src.path)
+            mod = importlib.util.module_from_spec(spec_mod)
+            spec_mod.loader.exec_module(mod)
+            expected = mod.generate()
+        except Exception as exc:  # noqa: BLE001 — report, don't crash the lint
+            findings.append(src.finding(
+                "MT-P105", 1, f"binding generator failed to run: {exc!r}"))
+            continue
+        if expected != bindings.read_text():
+            findings.append(Finding(
+                "MT-P105", _rel_sibling(src, bindings), 1,
+                "checked-in _bindings.py does not match gen_bindings.py "
+                "output for specs/*.json — regenerate with "
+                "`python -m mpit_tpu.comm.native.gen_bindings`",
+                abspath=bindings.as_posix()))
+    return findings
+
+
+def _rel_sibling(src: SourceFile, sibling: pathlib.Path) -> str:
+    """Display path for a file next to ``src``, in src's rel coordinates."""
+    base = pathlib.PurePosixPath(src.rel).parent
+    return (base / sibling.name).as_posix()
+
+
+def check(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    table, tag_lines = _load_tag_table(files)
+    if table:
+        fns = _collect_role_fns(files, table)
+        findings += _check_pairing(table, tag_lines, fns)
+        findings += _check_ack_discipline(table, fns)
+        findings += _check_deadlock_shape(fns)
+    findings += _check_spec_drift(files)
+    return findings

@@ -48,6 +48,11 @@ from mpit_tpu.ops.tiles import (
 
 NEG_INF = float("-inf")
 
+# jax renamed TPUCompilerParams -> CompilerParams across versions; accept
+# either so the kernels run on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
 # In-kernel running-max sentinel.  A FINITE very-negative value instead
 # of -inf: every `isneginf` guard in the hot loop disappears (exp of
 # (-1e30 - x) underflows to exactly 0, which is what the guards
@@ -73,7 +78,11 @@ def _fa_compiler_params(vmem_mb_auto: float = 0.0):
     floor for configs that cannot compile under the stock budget (the
     length-aware block_q=2048 forward default); the env lever, when
     set, wins over it — including an explicit 0, which pins the stock
-    budget (the A/B control) and suppresses the auto raise."""
+    budget (the A/B control) and suppresses the auto raise.  The
+    length-aware block defaults honour the pin: a budget below their
+    floor makes :func:`_tile_dims` keep the flat 1024 blocks
+    (:func:`_long_blocks_fit_vmem`), so the control combination stays
+    compilable."""
     kwargs = {}
     env = os.environ.get("MPIT_FA_VMEM_MB", "")
     vmem_mb = float(env) if env else vmem_mb_auto
@@ -81,7 +90,7 @@ def _fa_compiler_params(vmem_mb_auto: float = 0.0):
         kwargs["vmem_limit_bytes"] = int(vmem_mb * 2**20)
     if os.environ.get("MPIT_FA_DIMSEM", "1") != "0":
         kwargs["dimension_semantics"] = ("parallel", "arbitrary")
-    return pltpu.CompilerParams(**kwargs) if kwargs else None
+    return _CompilerParams(**kwargs) if kwargs else None
 
 
 def _vmem_auto(bq: int, bk: int) -> float:
@@ -93,6 +102,19 @@ def _vmem_auto(bq: int, bk: int) -> float:
     diverge them; an explicit MPIT_FA_VMEM_MB (incl. =0) still wins in
     :func:`_fa_compiler_params`."""
     return 64.0 if bq * bk * 4 > 4 * 2**20 else 0.0
+
+
+def _long_blocks_fit_vmem(bq: int, bk: int) -> bool:
+    """Whether the length-aware 2048-block *default* may be used under
+    the effective scoped-VMEM budget.  An explicit ``MPIT_FA_VMEM_MB``
+    wins over the auto raise — including ``=0``, the stock-budget A/B
+    control — so when it pins a budget below the floor the big tile
+    needs (:func:`_vmem_auto`), the default must fall back to the flat
+    1024 blocks instead of resolving a geometry that cannot compile
+    (ADVICE round 5).  Explicitly-passed block sizes are never second-
+    guessed; only the length-aware default growth is gated here."""
+    env = os.environ.get("MPIT_FA_VMEM_MB", "")
+    return not env or float(env) >= _vmem_auto(bq, bk)
 
 
 # ---------------------------------------------------------------------------
@@ -334,15 +356,24 @@ def _tile_dims(lq, lk, d, block_q, block_k, sm_scale, dtype,
     only at 32k+ where the win is measured.  MPIT_FA_LONG_BK_BWD=0 pins
     the flat default.  block_q stays 1024 in the backward (2048x2048
     measured far slower — the backward holds more live tiles per
-    program)."""
+    program).
+
+    Both length-aware defaults additionally require the effective
+    scoped-VMEM budget to admit the 2048 tile
+    (:func:`_long_blocks_fit_vmem`): an explicit MPIT_FA_VMEM_MB below
+    the 64 MB floor — notably ``=0``, the stock-budget A/B control —
+    keeps the flat defaults rather than resolving an uncompilable
+    geometry."""
     dq, dk = _default_blocks(dtype)
     if (fwd_long_bq and block_q is None and lq >= 16384
             and jnp.dtype(dtype).itemsize <= 2
-            and os.environ.get("MPIT_FA_LONG_BQ", "1") != "0"):
+            and os.environ.get("MPIT_FA_LONG_BQ", "1") != "0"
+            and _long_blocks_fit_vmem(2048, dk if block_k is None else block_k)):
         dq = 2048
     if (bwd_long_bk and block_k is None and lk >= 32768
             and jnp.dtype(dtype).itemsize <= 2
-            and os.environ.get("MPIT_FA_LONG_BK_BWD", "1") != "0"):
+            and os.environ.get("MPIT_FA_LONG_BK_BWD", "1") != "0"
+            and _long_blocks_fit_vmem(dq if block_q is None else block_q, 2048)):
         dk = 2048
     block_q = dq if block_q is None else block_q
     block_k = dk if block_k is None else block_k

@@ -1,0 +1,23 @@
+"""Seeded client role (mtlint fixture — parsed, never imported)."""
+
+import tags
+from aio import aio_recv, aio_send
+
+
+def send_ping(transport, live):
+    # MT-P102: the server role has no recv for PING.
+    yield from aio_send(transport, b"", 0, tags.PING, live=live)
+
+
+def push_grad(transport, grad):
+    # MT-P103: GRAD is a write tag (GRAD_ACK exists) but the ack tail
+    # is never received here.
+    yield from aio_send(transport, grad, 0, tags.GRAD)
+
+
+def fetch(transport):
+    # MT-P104: blocks on REPLY before sending REQ, while the server
+    # sends REPLY only after receiving REQ.
+    out = yield from aio_recv(transport, 0, tags.REPLY)
+    yield from aio_send(transport, b"", 0, tags.REQ)
+    return out

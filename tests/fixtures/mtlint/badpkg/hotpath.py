@@ -1,0 +1,19 @@
+"""Seeded JAX hot-path violations (mtlint fixture — parsed, never imported)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_step(w, g):
+    lr = float(g[0])  # MT-J301: host sync on a traced value
+    if jnp.any(g > 0):  # MT-J302: Python branch on a traced expression
+        w = w - lr * g
+    return w
+
+
+def update(w, g):
+    return w - 0.1 * g
+
+
+apply_update = jax.jit(update)  # MT-J303: update-shaped, no donate_argnums

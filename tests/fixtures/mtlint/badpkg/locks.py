@@ -1,0 +1,31 @@
+"""Seeded concurrency violations (mtlint fixture — parsed, never imported)."""
+
+import threading
+import time
+
+EXEC = "EXEC"
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self.items = []
+
+    def a_then_b(self):
+        with self._lock:
+            with self._cv:  # edge _lock -> _cv
+                self.items.append(1)
+
+    def b_then_a(self):
+        with self._cv:
+            with self._lock:  # MT-C201: edge _cv -> _lock inverts a_then_b
+                self.items.append(2)
+
+    def hold_and_sleep(self):
+        with self._lock:
+            time.sleep(0.1)  # MT-C202: blocking while holding _lock
+
+    def pump(self):
+        with self._lock:
+            yield EXEC  # MT-C203: parked by the scheduler lock-in-hand

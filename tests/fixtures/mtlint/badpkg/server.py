@@ -1,0 +1,17 @@
+"""Seeded server role (mtlint fixture — parsed, never imported)."""
+
+import tags
+from aio import aio_recv, aio_send
+
+
+def serve_grad(transport, buf):
+    # Correct write path: recv GRAD, send the GRAD_ACK tail.
+    got = yield from aio_recv(transport, 1, tags.GRAD, out=buf)
+    yield from aio_send(transport, b"", 1, tags.GRAD_ACK)
+    return got
+
+
+def serve_req(transport):
+    # Half of the seeded MT-P104 cycle: REPLY only after REQ.
+    yield from aio_recv(transport, 1, tags.REQ)
+    yield from aio_send(transport, b"", 1, tags.REPLY)

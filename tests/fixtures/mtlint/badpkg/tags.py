@@ -1,0 +1,8 @@
+"""Seeded-violation tag table (mtlint fixture — never imported)."""
+
+PING = 1  # seeded MT-P102: client sends, server never receives
+GRAD = 2
+GRAD_ACK = 3
+REQ = 4
+REPLY = 5
+ORPHAN = 6  # seeded MT-P101: defined, never used by any role

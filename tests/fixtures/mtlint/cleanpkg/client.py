@@ -1,0 +1,14 @@
+"""Clean client role (mtlint fixture — zero findings expected)."""
+
+import tags
+from aio import aio_recv, aio_send
+
+
+def send_grad(transport, grad, live):
+    yield from aio_send(transport, grad, 0, tags.GRAD, live=live)
+    yield from aio_recv(transport, 0, tags.GRAD_ACK, live=live)
+
+
+def recv_param(transport, out, live):
+    yield from aio_send(transport, b"", 0, tags.PARAM_REQ, live=live)
+    yield from aio_recv(transport, 0, tags.PARAM, live=live, out=out)

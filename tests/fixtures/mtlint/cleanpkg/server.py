@@ -1,0 +1,15 @@
+"""Clean server role (mtlint fixture — zero findings expected)."""
+
+import tags
+from aio import aio_recv, aio_send
+
+
+def serve_grad(transport, buf, live):
+    got = yield from aio_recv(transport, 1, tags.GRAD, out=buf, live=live)
+    yield from aio_send(transport, b"", 1, tags.GRAD_ACK, live=live)
+    return got
+
+
+def serve_param(transport, snapshot, live):
+    yield from aio_recv(transport, 1, tags.PARAM_REQ, live=live)
+    yield from aio_send(transport, snapshot, 1, tags.PARAM, live=live)

@@ -1,0 +1,6 @@
+"""Clean tag table (mtlint fixture — every channel fully paired)."""
+
+GRAD = 1
+GRAD_ACK = 2
+PARAM_REQ = 3
+PARAM = 4

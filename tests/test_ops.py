@@ -244,6 +244,39 @@ def test_bwd_long_bk_block_routing(monkeypatch):
     assert _use_fused_bwd(*args32) is False
 
 
+def test_vmem_pin_keeps_flat_block_defaults(monkeypatch):
+    """ADVICE round-5 regression: MPIT_FA_VMEM_MB=0 (the stock-budget
+    A/B control) suppresses the auto VMEM raise, so the length-aware
+    2048-block defaults — whose >4 MB score tile cannot compile under
+    the stock 16 MB budget — must fall back to the flat 1024 blocks.
+    Any explicit budget below the 64 MB floor pins the same fallback; a
+    budget at/above it (and the unset default) keeps the grown tiles."""
+    from mpit_tpu.ops.flash_attention import _tile_dims
+
+    def blocks_of(**env):
+        monkeypatch.delenv("MPIT_FA_VMEM_MB", raising=False)
+        for kk, vv in env.items():
+            monkeypatch.setenv(kk, vv)
+        fwd = _tile_dims(32768, 32768, 128, None, None, None, jnp.bfloat16,
+                         fwd_long_bq=True)
+        bwd = _tile_dims(32768, 32768, 128, None, None, None, jnp.bfloat16,
+                         bwd_long_bk=True)
+        return fwd[1], bwd[2]
+
+    assert blocks_of() == (2048, 2048)  # unset: length-aware defaults
+    # The documented control combination (ADVICE: flash_attention.py
+    # _fa_compiler_params) now resolves a compilable geometry.
+    assert blocks_of(MPIT_FA_VMEM_MB="0") == (1024, 1024)
+    assert blocks_of(MPIT_FA_VMEM_MB="16") == (1024, 1024)  # below floor
+    assert blocks_of(MPIT_FA_VMEM_MB="64") == (2048, 2048)  # at floor
+    assert blocks_of(MPIT_FA_VMEM_MB="100") == (2048, 2048)
+    # Explicit block sizes are never second-guessed by the pin.
+    out = _tile_dims(32768, 32768, 128, 2048, None, None, jnp.bfloat16,
+                     fwd_long_bq=True)
+    assert out[1] == 2048
+    monkeypatch.delenv("MPIT_FA_VMEM_MB", raising=False)
+
+
 @pytest.mark.parametrize("fa_backward_path", ["1", "0"], indirect=True,
                          ids=["fused-bwd", "two-kernel-bwd"])
 @pytest.mark.parametrize("blocks", [(1024, 2048)])

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""mtlint launcher — run the framework-aware static analyzer from a
+checkout without installing the package:
+
+    python tools/mtlint.py mpit_tpu/
+    python tools/mtlint.py tests/fixtures/mtlint/badpkg   # exits nonzero
+
+Installed entry point: ``mtlint`` (pyproject [project.scripts]).
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from mpit_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
