@@ -254,16 +254,64 @@ def _device_responsive_with_retry() -> bool:
     return False
 
 
+def _outage_record() -> dict:
+    return {
+        "metric": "mnist_easgd_train_samples_per_sec",
+        "value": None, "unit": "samples/s", "vs_baseline": None,
+        "error": "device unresponsive: a trivial jitted matmul never "
+                 "completed within a 240s probe (tunnel outage; "
+                 f"probed {_probe_retries()} times before giving up)",
+    }
+
+
+def _cpu_fallback() -> int:
+    """The accelerator is wedged: capture the whole bench on the CPU
+    backend in a child process (JAX_PLATFORMS=cpu) and emit that record
+    tagged ``"backend": "cpu"`` — a degraded-but-real measurement.
+    Rounds 4 and 5 (BENCH_r04/05.json) emitted ``value: null`` on tunnel
+    outages and lost their perf evidence entirely; a CPU capture keeps
+    the record comparable run-over-run.  Returns the exit code."""
+    import subprocess
+
+    _log("device unresponsive: falling back to a JAX_PLATFORMS=cpu capture")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MPIT_BENCH_PROBE_RETRIES="1")
+    timeout = float(os.environ.get("MPIT_BENCH_CPU_TIMEOUT", "5400"))
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        for stream in (e.stdout, e.stderr):
+            if stream:
+                sys.stderr.write(stream if isinstance(stream, str)
+                                 else stream.decode(errors="replace"))
+        _log(f"cpu fallback capture timed out after {timeout:.0f}s")
+        print(json.dumps(_outage_record()))
+        return 1
+    sys.stderr.write(out.stderr)
+    lines = [ln for ln in out.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    if out.returncode != 0 or not lines:
+        _log(f"cpu fallback capture failed rc={out.returncode}")
+        print(json.dumps(_outage_record()))
+        return 1
+    rec = json.loads(lines[-1])
+    rec["backend"] = "cpu"
+    rec["fallback"] = ("accelerator unresponsive after probe retries; "
+                       "JAX_PLATFORMS=cpu capture")
+    print(json.dumps(rec))
+    return 0
+
+
 def main():
     if not _device_responsive_with_retry():
-        print(json.dumps({
-            "metric": "mnist_easgd_train_samples_per_sec",
-            "value": None, "unit": "samples/s", "vs_baseline": None,
-            "error": "device unresponsive: a trivial jitted matmul never "
-                     "completed within a 240s probe (tunnel outage; "
-                     f"probed {_probe_retries()} times before giving up)",
-        }))
-        sys.exit(1)
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # Already the fallback backend (or an explicit CPU run) —
+            # nothing further to degrade to.
+            print(json.dumps(_outage_record()))
+            sys.exit(1)
+        sys.exit(_cpu_fallback())
     trains = []
     for rep in range(REPS):
         _log(f"-- train rep {rep + 1}/{REPS} --")
@@ -296,8 +344,11 @@ def main():
     base = _median(torch_runs) if torch_runs else 0.0
     vs = sps / base if base > 0 else 0.0
 
+    import jax
+
     print(json.dumps({
         "metric": "mnist_easgd_train_samples_per_sec",
+        "backend": jax.default_backend(),
         "value": round(sps, 1),
         "unit": "samples/s",
         "vs_baseline": round(vs, 3),

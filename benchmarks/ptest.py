@@ -11,16 +11,30 @@ script measures both rebuild transports:
   (:func:`mpit_tpu.parallel.collective.ps_pushpull`), i.e. the traffic
   pattern the reference drives through MPI, riding ICI instead.
 - **shm** — the host path: ParamClient/ParamServer over the native C++
-  shared-memory transport (servers on their own threads, the C ring
-  releases the GIL), the analog of MPI's shared-memory BTL on one host.
+  shared-memory transport, **one OS process per rank** (the reference's
+  ``mpirun -np N`` shape; train/gang.py is the trainer's analog of the
+  same spawner).  ``MPIT_BENCH_GANG=threads`` keeps the old
+  all-ranks-in-one-process mode, but that shares a single GIL across
+  every rank's scheduler and codec work: the convoy effect slows the
+  tiled int8 encoder ~10x under three busy sibling threads (measured on
+  the 1-core bench host), so thread-mode numbers understate every codec
+  and flatten A/B ratios — use it only for debugging.
 
 Env knobs: MPIT_BENCH_MB (payload size, default 64), MPIT_BENCH_ROUNDS
 (default 20), MPIT_BENCH_MODE (ici|shm|both, default both),
 MPIT_BENCH_SERVERS / MPIT_BENCH_CLIENTS for the shm topology (default
-2/2, the reference's np=4 split).
+2/2, the reference's np=4 split), MPIT_BENCH_GANG (procs|threads,
+default procs), MPIT_PS_CODEC (wire codec for the shm leg —
+comm/codec.py), and MPIT_BENCH_CODECS (comma list, e.g.
+"none,bf16,int8": run the shm leg once per codec — the codec A/B sweep,
+docs/PROTOCOL.md §5).  MPIT_BENCH_REPS (default 1 here) repeats each
+shm leg and reports the median + per-run values.
 
-Prints one JSON line per mode: MB/s bi-directional, plus per-chip for
-the ici mode.
+Prints one JSON line per mode (and per codec in a sweep): MB/s
+bi-directional, plus per-chip for the ici mode.  MB/s counts *logical*
+payload bytes (2 * size * 4 per round per client) — with a quantizing
+codec the wire moves fewer bytes, which is exactly the effect being
+measured.
 """
 
 from __future__ import annotations
@@ -43,6 +57,9 @@ ROUNDS = int(os.environ.get("MPIT_BENCH_ROUNDS", "20"))
 MODE = os.environ.get("MPIT_BENCH_MODE", "both")
 NSERVERS = int(os.environ.get("MPIT_BENCH_SERVERS", "2"))
 NCLIENTS = int(os.environ.get("MPIT_BENCH_CLIENTS", "2"))
+CODECS = [c for c in os.environ.get("MPIT_BENCH_CODECS", "").split(",") if c]
+REPS = max(int(os.environ.get("MPIT_BENCH_REPS", "1")), 1)
+GANG = os.environ.get("MPIT_BENCH_GANG", "procs")
 
 
 def bench_ici() -> dict:
@@ -61,19 +78,197 @@ def bench_ici() -> dict:
     }
 
 
-def bench_shm() -> dict:
-    size = int(MB * (1 << 20) / 4)
-    _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, "
-         f"payload {size * 4 / 2**20:.1f} MB")
+def bench_shm(codec: str = "") -> dict:
+    """One shm PS push/pull measurement; ``codec`` overrides
+    MPIT_PS_CODEC for the gang (read at client/server construction)."""
+    import numpy as np
 
-    # Ring sized to hold a full per-server shard (x2 both directions,
-    # plus header slack): with the 16 MB default a 640 MB-payload
-    # transfer needs the ring drained ~20x mid-message, each handoff
-    # paying a GIL quantum on a shared core.
+    from mpit_tpu.comm import codec as codec_mod
+
+    if codec:
+        os.environ["MPIT_PS_CODEC"] = codec
+    codec_name = codec_mod.get(codec or None).name
+    size = int(MB * (1 << 20) / 4)
+    _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, codec "
+         f"{codec_name}, payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
+
+    run = _shm_run_procs if GANG == "procs" else _shm_run_threads
+    runs = [run(size) for _ in range(REPS)]
+    mbs = float(np.median(np.asarray(runs)))
+    _log(f"[shm] codec {codec_name}: median {mbs:.1f} MB/s over {runs}")
+    return {
+        "metric": "ps_pushpull_bandwidth_shm",
+        "value": round(mbs, 1),
+        "unit": "MB/s",
+        "codec": codec_name,
+        "gang": GANG,
+        "reps": REPS,
+        "value_runs": [round(v, 1) for v in runs],
+        "clients": NCLIENTS,
+        "servers": NSERVERS,
+    }
+
+
+_GANG_SEQ = [0]  # unique shm namespace per gang within this process
+
+
+def _ring_bytes(size: int) -> int:
+    # Ring sized for the rank's aggregate inbound traffic: every peer on
+    # the other side may have a full shard in flight into this rank's
+    # one inbox ring (2 clients -> 1 server ring, and vice versa), so a
+    # per-shard ring is perpetually full and each transfer degrades into
+    # ring-granularity handoff cycles — each paying a scheduling quantum
+    # on a shared core (a whole OS timeslice in the process gang).
     shard_bytes = size * 4 // max(NSERVERS, 1)
-    ring = max(64 << 20, 2 * shard_bytes + (16 << 20))
-    with shm_gang(f"ptest_{os.getpid()}", NSERVERS, NCLIENTS, size,
-                  ring_bytes=ring) as (
+    peers = max(NSERVERS, NCLIENTS)
+    return max(64 << 20, 2 * peers * shard_bytes + (16 << 20))
+
+
+def _shm_run_procs(size: int) -> float:
+    """One timed gang, one OS process per rank: servers run the PS serve
+    loop, clients run T rounds of {pull, push, wait} and report their
+    round-loop window; aggregate MB/s uses the union of the client
+    windows, so child startup (jax import, seeding) is excluded."""
+    import subprocess
+    import tempfile
+
+    nranks = NSERVERS + NCLIENTS
+    _GANG_SEQ[0] += 1
+    ns = f"ptest_{os.getpid()}_{_GANG_SEQ[0]}"
+    spec = {
+        "ns": ns, "nservers": NSERVERS, "nclients": NCLIENTS,
+        "size": size, "ring": _ring_bytes(size), "rounds": ROUNDS,
+    }
+    tmpdir = tempfile.mkdtemp(prefix=f"{ns}_")
+    procs, result_files = [], []
+    for rank in range(nranks):
+        result_path = os.path.join(tmpdir, f"rank{rank}.json")
+        result_files.append(result_path)
+        log_path = os.path.join(tmpdir, f"rank{rank}.log")
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", PTEST_GANG=json.dumps(spec),
+            PTEST_RANK=str(rank), PTEST_RESULT=result_path,
+        )
+        with open(log_path, "w") as fh:
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--gang-child"],
+                env=env, stdout=fh, stderr=subprocess.STDOUT, text=True,
+            ))
+    deadline = time.monotonic() + float(
+        os.environ.get("MPIT_BENCH_GANG_TIMEOUT", "900"))
+    try:
+        while any(p.poll() is None for p in procs):
+            bad = next((r for r, p in enumerate(procs)
+                        if p.poll() not in (None, 0)), None)
+            if bad is not None or time.monotonic() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for r, path in enumerate(result_files):
+                    with open(path.replace(".json", ".log")) as fh:
+                        sys.stderr.write(fh.read())
+                raise RuntimeError(
+                    f"gang rank {bad} failed (logs: {tmpdir})"
+                    if bad is not None else
+                    f"gang timed out (logs: {tmpdir})"
+                )
+            time.sleep(0.1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    windows = []
+    for rank in range(NSERVERS, nranks):
+        with open(result_files[rank]) as fh:
+            rec = json.load(fh)
+        windows.append((rec["t0"], rec["t1"]))
+    dt = max(w[1] for w in windows) - min(w[0] for w in windows)
+    import shutil
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    mbs = 2 * ROUNDS * NCLIENTS * size * 4 / dt / 2**20
+    _log(f"[shm] {ROUNDS} rounds x {NCLIENTS} client procs in {dt:.3f}s "
+         f"-> {mbs:.1f} MB/s aggregate")
+    return mbs
+
+
+def _gang_child() -> None:
+    """One rank of the process gang (--gang-child): a server runs the
+    serve loop to completion; a client times its round loop and writes
+    the window to PTEST_RESULT."""
+    import numpy as np
+
+    from mpit_tpu.comm.collectives import HostCollectives
+    from mpit_tpu.comm.shm import ShmTransport
+    from mpit_tpu.ps import ParamClient, ParamServer
+
+    spec = json.loads(os.environ["PTEST_GANG"])
+    rank = int(os.environ["PTEST_RANK"])
+    nranks = spec["nservers"] + spec["nclients"]
+    sranks = list(range(spec["nservers"]))
+    cranks = list(range(spec["nservers"], nranks))
+    size = spec["size"]
+    transport = ShmTransport(spec["ns"], rank, nranks,
+                             ring_bytes=spec["ring"])
+    # Startup barrier: no PS traffic until every ring is mapped (the
+    # mpirun-gives-you-this guarantee, same as train/gang.py).
+    HostCollectives(transport).barrier()
+    if rank in sranks:
+        server = ParamServer(rank, cranks, transport, rule="add")
+        server.start()
+        result = {
+            "role": "server", "grads_applied": server.grads_applied,
+            "snapshot_copies": server.snapshot_copies,
+            "snapshot_hits": server.snapshot_hits,
+        }
+    else:
+        client = ParamClient(rank, sranks, transport,
+                             seed_servers=(rank == cranks[0]))
+        param = np.zeros(size, np.float32)
+        grad = np.full(size, 1e-6, np.float32)
+        client.start(param, grad)
+        # Align client windows before timing: a non-seeding client's
+        # start() returns while the seeder is still pushing the whole
+        # vector, and an unaligned window would fold that seeding time
+        # into the measured aggregate.  One warmup pull per client (so
+        # every server has served once), then a client-only barrier on a
+        # tag outside the PS/collectives ranges.
+        client.async_recv_param()
+        client.wait()
+        _SYNC_TAG = 59999
+        if rank == cranks[0]:
+            for peer in cranks[1:]:
+                while not transport.iprobe(peer, _SYNC_TAG):
+                    pass
+                transport.recv(peer, _SYNC_TAG)
+            for peer in cranks[1:]:
+                transport.send(b"go", peer, _SYNC_TAG)
+        else:
+            transport.send(b"rdy", cranks[0], _SYNC_TAG)
+            while not transport.iprobe(cranks[0], _SYNC_TAG):
+                pass
+            transport.recv(cranks[0], _SYNC_TAG)
+        t0 = time.time()
+        for _ in range(spec["rounds"]):
+            client.async_recv_param()
+            client.async_send_grad()
+            client.wait()
+        t1 = time.time()
+        client.stop()
+        result = {"role": "client", "t0": t0, "t1": t1}
+    transport.close()
+    with open(os.environ["PTEST_RESULT"], "w") as fh:
+        json.dump(result, fh)
+
+
+def _shm_run_threads(size: int) -> float:
+    """One timed gang: T rounds of {pull, push, wait} per client, all
+    ranks as threads of this process (debug mode — see module docstring
+    for why this understates codec throughput)."""
+    ring = _ring_bytes(size)
+    _GANG_SEQ[0] += 1
+    ns = f"ptest_{os.getpid()}_{_GANG_SEQ[0]}"
+    with shm_gang(ns, NSERVERS, NCLIENTS, size, ring_bytes=ring) as (
         clients, _params, _grads
     ):
         def client_rounds(i):
@@ -97,16 +292,10 @@ def bench_shm() -> dict:
     mbs = 2 * ROUNDS * NCLIENTS * size * 4 / dt / 2**20
     _log(f"[shm] {ROUNDS} rounds x {NCLIENTS} clients in {dt:.3f}s "
          f"-> {mbs:.1f} MB/s aggregate")
-    return {
-        "metric": "ps_pushpull_bandwidth_shm",
-        "value": round(mbs, 1),
-        "unit": "MB/s",
-        "clients": NCLIENTS,
-        "servers": NSERVERS,
-    }
+    return mbs
 
 
-def _bench_shm_subprocess() -> dict:
+def _bench_shm_subprocess(codec: str = "") -> dict:
     """Run the shm leg in a child with JAX_PLATFORMS=cpu: the PS server's
     shard state must live host-side (ps/server.py device='cpu'), but
     accelerator plugins like the axon tunnel remove the in-process CPU
@@ -114,7 +303,11 @@ def _bench_shm_subprocess() -> dict:
     ici leg."""
     import subprocess
 
-    env = dict(os.environ, MPIT_BENCH_MODE="shm", JAX_PLATFORMS="cpu")
+    env = dict(os.environ, MPIT_BENCH_MODE="shm", JAX_PLATFORMS="cpu",
+               MPIT_BENCH_GANG="threads")
+    env.pop("MPIT_BENCH_CODECS", None)  # parent drives the sweep
+    if codec:
+        env["MPIT_PS_CODEC"] = codec
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,
@@ -139,15 +332,25 @@ def _bench_shm_subprocess() -> dict:
 
 def main():
     results = []
+    sweep = CODECS or [""]
     if MODE in ("ici", "both"):
         results.append(bench_ici())
     if MODE == "shm":
-        results.append(bench_shm())
+        results.extend(bench_shm(c) for c in sweep)
     elif MODE == "both":
-        results.append(_bench_shm_subprocess())
+        if GANG == "procs":
+            # Every rank is its own child process with JAX_PLATFORMS=cpu;
+            # this parent keeps the accelerator for the ici leg and never
+            # touches jax on the shm path.
+            results.extend(bench_shm(c) for c in sweep)
+        else:
+            results.extend(_bench_shm_subprocess(c) for c in sweep)
     for r in results:
         print(json.dumps(r))
 
 
 if __name__ == "__main__":
-    main()
+    if "--gang-child" in sys.argv:
+        _gang_child()
+    else:
+        main()

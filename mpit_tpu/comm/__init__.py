@@ -28,6 +28,7 @@ coordination with no accelerator in the loop.  (Device collectives ride
 XLA over ICI instead: :mod:`mpit_tpu.parallel.collective`.)
 """
 
+from mpit_tpu.comm import codec
 from mpit_tpu.comm.transport import Handle, Transport
 from mpit_tpu.comm.local import LocalRouter, LocalTransport
 from mpit_tpu.comm.tcp import TcpTransport, allocate_local_addresses
@@ -36,4 +37,5 @@ from mpit_tpu.comm.collectives import HostCollectives
 __all__ = [
     "Transport", "Handle", "LocalRouter", "LocalTransport",
     "TcpTransport", "allocate_local_addresses", "HostCollectives",
+    "codec",
 ]

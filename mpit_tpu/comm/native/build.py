@@ -17,7 +17,15 @@ LIB = HERE / "libmt_transport.so"
 
 _lock = threading.Lock()
 
-CXXFLAGS = ["-std=c++17", "-O2", "-fPIC", "-shared", "-pthread", "-Wall"]
+# -O3 for the auto-vectorizer (GCC<12 does not vectorize at -O2; the codec
+# kernels need it), -march=native because the library is built lazily on
+# the host that runs it (baseline x86-64 is SSE2, which has no vector
+# rounding insn — the int8 quantize loop needs SSE4.1+ vroundps),
+# -fno-math-errno so rintf lowers to that insn, and -ffp-contract=off so
+# the codec's float results stay bit-identical to the numpy reference
+# implementations (tests/test_codec.py parity oracle).
+CXXFLAGS = ["-std=c++17", "-O3", "-march=native", "-fPIC", "-shared",
+            "-pthread", "-Wall", "-fno-math-errno", "-ffp-contract=off"]
 
 
 def ensure_built(force: bool = False) -> pathlib.Path:

@@ -142,11 +142,18 @@ class ShmTransport(Transport):
     @staticmethod
     def _sendable(data: Any):
         """Keepalive-friendly buffer form: ndarray stays as-is (raw pointer
-        + held reference), everything else becomes bytes."""
+        + held reference), everything else becomes bytes.  Non-contiguous
+        arrays are rejected rather than silently copied — same fail-loud
+        zero-copy rule as :func:`mpit_tpu.comm.transport.as_bytes_view`."""
         if data is None:
             return b""
         if isinstance(data, np.ndarray):
-            return np.ascontiguousarray(data)
+            if not data.flags["C_CONTIGUOUS"]:
+                raise ValueError(
+                    "send buffer must be C-contiguous (zero-copy rule: a "
+                    "hidden copy would break buffer-liveness semantics)"
+                )
+            return data
         if isinstance(data, (bytes, bytearray)):
             return bytes(data)
         if isinstance(data, memoryview):

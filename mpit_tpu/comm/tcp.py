@@ -55,6 +55,9 @@ _EMPTY = memoryview(b"")
 # from a crash (fail-loud semantics).  User tags are non-negative
 # (ps/tags.py, collectives' 2^16+ range), so the sentinel can't collide.
 _GOODBYE_TAG = -(1 << 62)
+# Scatter-gather frame writes (one syscall for header+payload, zero
+# concatenation): POSIX-only; Windows sockets lack sendmsg.
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
 
 
 class MeshMismatchError(ConnectionError):
@@ -578,6 +581,29 @@ class TcpTransport(Transport):
                     h.cancelled = True
                     h.meta["error"] = err
 
+    @staticmethod
+    def _send_frame(conn: socket.socket, header: bytes, payload) -> None:
+        """Write one frame with a scatter-gather ``sendmsg``: header and
+        payload go to the kernel in a single syscall from their own
+        buffers — no concatenation copy, and no separate header write
+        for TCP_NODELAY to flush as its own small packet.  Loops on
+        partial writes (sendmsg, like send, may stop mid-buffer)."""
+        if not _HAS_SENDMSG:  # pragma: no cover - non-POSIX fallback
+            conn.sendall(header)
+            if payload.nbytes:
+                conn.sendall(payload)
+            return
+        bufs = [memoryview(header)]
+        if payload.nbytes:
+            bufs.append(payload)
+        while bufs:
+            sent = conn.sendmsg(bufs)
+            while bufs and sent >= bufs[0].nbytes:
+                sent -= bufs[0].nbytes
+                bufs.pop(0)
+            if sent and bufs:
+                bufs[0] = bufs[0][sent:]
+
     def _writer(self, peer: int, conn: socket.socket, gen: int) -> None:
         cv = self._out_cv[peer]
         box = self._outboxes[peer]
@@ -604,9 +630,7 @@ class TcpTransport(Transport):
                     self._pending_ack[peer] = None
                 handle, header, payload, retain_seq = entry
             try:
-                conn.sendall(header)
-                if payload.nbytes:
-                    conn.sendall(payload)
+                self._send_frame(conn, header, payload)
             except OSError:
                 if self.reconnect > 0 and not self._closed:
                     # Leave the frame at the head for the successor.

@@ -39,9 +39,21 @@ class Handle:
 
 
 def as_bytes_view(data: Any) -> memoryview:
-    """A contiguous read-only byte view over array/bytes-like data."""
+    """A contiguous byte view over array/bytes-like data.
+
+    Fail-loud zero-copy rule: a non-contiguous ndarray would need a
+    silent ``ascontiguousarray`` copy — after which the documented
+    liveness contract ("buffer stays alive and unmodified until test()")
+    binds the caller to the *wrong* buffer: mutations between isend and
+    completion would be invisibly dropped.  Raise like the recv path
+    does instead; callers own making their send buffers contiguous."""
     if isinstance(data, np.ndarray):
-        return memoryview(np.ascontiguousarray(data)).cast("B")
+        if not data.flags["C_CONTIGUOUS"]:
+            raise ValueError(
+                "send buffer must be C-contiguous (zero-copy rule: a "
+                "hidden copy would break buffer-liveness semantics)"
+            )
+        return memoryview(data).cast("B")
     return memoryview(data).cast("B") if not isinstance(data, memoryview) else data.cast("B")
 
 

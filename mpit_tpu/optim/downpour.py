@@ -17,6 +17,16 @@ and the whole local step (feval + scale + accumulate + local move) is one
 jitted XLA program; host<->device transfers happen only on sync steps, and
 the host-side buffers the client ships are written with one device->host
 copy (the reference instead mutates shared host tensors every step).
+
+Wire codecs (``MPIT_PS_CODEC``): this driver needs no codec awareness —
+it writes fp32 deltas into the client's registered ``grad`` mirror and
+the ParamClient encodes at ship time.  With the lossy ``int8`` codec the
+client's per-shard error-feedback residual folds each sync's
+quantization error into the *next* shipped delta, so the server-side sum
+of applied updates tracks the true accumulated ``dfdx`` within one
+quantization step — the EF-SGD argument that keeps DOWNPOUR's
+convergence intact (docs/PROTOCOL.md §error feedback).  The fetched
+params are quantized too; su>1 local moves run on the exact local ``w``.
 """
 
 from __future__ import annotations

@@ -18,6 +18,16 @@ Between rounds only the local update runs.  TPU-native mechanics: w, vt and
 the elastic algebra live in device HBM; the elastic delta and local update
 are jitted XLA programs; only w* (in) and sug (out) cross the host boundary,
 once per round.
+
+Wire codecs (``MPIT_PS_CODEC``): the elastic push rides the client's GRAD
+channel, so with ``int8`` the shipped ``sug`` is block-quantized and the
+client's error-feedback residual re-ships each round's quantization error
+next round — the center ``w*`` integrates the exact elastic force over
+time even though individual pushes are lossy.  The local retract
+(``w -= sug``) deliberately uses the *exact* sug: the worker-side
+elastic symmetry stays unperturbed, and the center-side difference is
+covered by the residual.  Convergence matches the uncompressed run on
+the MNIST flagship (tests/test_trainer.py int8 variant).
 """
 
 from __future__ import annotations

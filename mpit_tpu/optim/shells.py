@@ -15,6 +15,17 @@ one worker runs the full optimizer locally — the same
 :mod:`mpit_tpu.optim.rules` math with plain bias correction — then pushes
 the whole parameter vector so the server acts as a parameter mirror for the
 tester rank (reference optim-adam-single.lua:35-36).
+
+Wire codecs (``MPIT_PS_CODEC``): both shells stay codec-oblivious — they
+write fp32 into the client's ``grad`` mirror and the ParamClient
+encodes/decodes at the wire.  Error feedback note for ``int8``: in
+'global' mode the *raw* gradient stream is what the residual corrects,
+which composes with su>1 accumulation (the accumulated delta is shipped
+as one frame, its quantization error rides into the next sync).
+SingleWorker's whole-param PARAM_PUSH mirror is a state transfer, not an
+accumulating signal — it ships without residual, so a lossy codec makes
+the mirror (and the tester reading it) approximate to one quantization
+step; pick ``none``/``bf16`` there if the tester must match exactly.
 """
 
 from __future__ import annotations

@@ -11,16 +11,28 @@ views, pclient.lua:50-52).  Public surface mirrors pclient.lua:84-179:
 The comm-aware optimizers (mpit_tpu.optim.downpour/easgd/shells) drive this
 class through the ParamClientAPI protocol; device arrays stay in the
 optimizer layer — the client only ever touches the registered host mirrors.
+
+Wire codecs (beyond-reference — the EQuARX direction, PAPERS.md): the
+client announces a codec in its INIT (``MPIT_PS_CODEC`` or the ``codec``
+argument; mpit_tpu/comm/codec.py) and every GRAD/PARAM/PARAM_PUSH frame
+to/from that server travels in that format.  For the lossy ``int8`` codec
+the client holds one error-feedback residual per shard: the gradient
+quantization error is added back into the next shipped gradient instead
+of being lost, so DOWNPOUR/EASGD converge as if uncompressed (the shells
+in mpit_tpu.optim need no changes — they keep writing fp32 into
+``grad``; encode happens here at ship time).  ``codec='none'`` keeps
+today's zero-copy slice sends byte-for-byte.
 """
 
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Dict, Generator, List, Optional
+from typing import Deque, Dict, Generator, List, Optional, Tuple
 
 import numpy as np
 
 from mpit_tpu.aio import LiveFlag, Scheduler, aio_recv, aio_send
+from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.ps import tags
 from mpit_tpu.ps.sharding import Shard, shard_layout
@@ -35,36 +47,53 @@ class ParamClient:
         transport: Transport,
         scheduler: Optional[Scheduler] = None,
         seed_servers: bool = False,
+        codec: Optional[str] = None,
     ):
         self.rank = rank
         self.sranks = list(server_ranks)
         self.transport = transport
         self.sched = scheduler or Scheduler()
         self.seed_servers = seed_servers  # this is the first client
+        self.codec = codec_mod.get(codec)  # None/'' -> $MPIT_PS_CODEC
         self.live = LiveFlag()
         self.log = get_logger("pclient", rank)
         self.param: Optional[np.ndarray] = None
         self.grad: Optional[np.ndarray] = None
         self.shards: List[Shard] = []
         self._started = False
+        # Per-server codec state: encode/decode staging sized to the wire
+        # format, plus the int8 error-feedback residual (grad path only).
+        self._grad_wire: Dict[int, np.ndarray] = {}
+        self._param_wire: Dict[int, np.ndarray] = {}
+        self._residual: Dict[int, np.ndarray] = {}
         # Per-server FIFO op chains: ops addressed to the same server run in
         # issue order (a send_grad's ack completes before a later param
         # request is sent), while different servers stay fully concurrent.
         # Strictly stronger than the reference (which relies on coroutine
         # spawn order for freshness, pclient.lua:84-109) — this removes the
         # stale-own-write race without giving up cross-server overlap.
-        self._opq: Dict[int, Deque[Generator]] = {}
+        self._opq: Dict[int, Deque[Tuple[Generator, str]]] = {}
         self._pump_live: Dict[int, bool] = {}
+        self._pump_task: Dict[int, Optional[object]] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self, param: np.ndarray, grad: np.ndarray) -> None:
-        """Announce shard layout to every server; the first client seeds
-        the servers' shards from ``param`` (reference pclient.lua:111-129)."""
+        """Announce shard layout + codec to every server; the first client
+        seeds the servers' shards from ``param`` (reference
+        pclient.lua:111-129).  INIT v2: int64 [offset, size, codec_id]."""
         self._register(param, grad)
         self.shards = shard_layout(len(param), len(self.sranks))
         for srank, shard in zip(self.sranks, self.shards):
-            cinfo = np.asarray([shard.offset, shard.size], dtype=np.int64)
+            if not self.codec.identity:
+                nbytes = self.codec.wire_nbytes(shard.size)
+                self._grad_wire[srank] = np.zeros(nbytes, np.uint8)
+                self._param_wire[srank] = np.zeros(nbytes, np.uint8)
+                if self.codec.uses_residual:
+                    self._residual[srank] = np.zeros(shard.size, np.float32)
+            cinfo = np.asarray(
+                [shard.offset, shard.size, self.codec.wire_id], dtype=np.int64
+            )
             self.sched.spawn(
                 aio_send(self.transport, cinfo, srank, tags.INIT, live=self.live),
                 name=f"send_init:{srank}",
@@ -83,11 +112,17 @@ class ParamClient:
             raise ValueError("param and grad must be 1-D with equal shape and dtype")
         if not param.flags["C_CONTIGUOUS"] or not grad.flags["C_CONTIGUOUS"]:
             raise ValueError("param and grad must be contiguous (zero-copy rule)")
+        if not self.codec.identity and param.dtype != np.float32:
+            raise ValueError(
+                f"codec {self.codec.name!r} quantizes float32 shards; got "
+                f"dtype {param.dtype} (use codec='none' for other dtypes)"
+            )
         self.param, self.grad = param, grad
 
     def reset(self, param: np.ndarray, grad: np.ndarray) -> None:
         """Retarget transfer buffers without re-announcing shards
-        (reference pclient.lua:138-151)."""
+        (reference pclient.lua:138-151).  Error-feedback residuals are
+        keyed by shard, not by buffer — they survive the retarget."""
         if self.shards and len(param) != self.shards[-1].end:
             raise ValueError("reset buffers must keep the registered length")
         self._register(param, grad)
@@ -96,41 +131,81 @@ class ParamClient:
 
     def _send_grad(self, srank: int, shard: Shard):
         """Ship the grad slice, await the applied ack
-        (reference pclient.lua:48-58)."""
+        (reference pclient.lua:48-58).  Non-identity codecs encode into
+        the per-server staging frame at ship time; the int8 residual is
+        folded in and refreshed by the same pass."""
         view = self.grad[shard.offset : shard.end]
-        yield from aio_send(self.transport, view, srank, tags.GRAD, live=self.live)
+        payload = self._encode(view, self._grad_wire.get(srank),
+                               residual=self._residual.get(srank))
+        yield from aio_send(self.transport, payload, srank, tags.GRAD, live=self.live)
         yield from aio_recv(self.transport, srank, tags.GRAD_ACK, live=self.live)
 
     def _recv_param(self, srank: int, shard: Shard):
         """Request-to-read header, then receive into the param slice
-        (reference pclient.lua:72-82)."""
+        (reference pclient.lua:72-82) — via the wire staging frame when
+        the codec is not identity."""
         yield from aio_send(
             self.transport, tags.EMPTY, srank, tags.PARAM_REQ, live=self.live
         )
         out = self.param[shard.offset : shard.end]
-        yield from aio_recv(self.transport, srank, tags.PARAM, live=self.live, out=out)
+        wire = self._param_wire.get(srank)
+        got = yield from aio_recv(
+            self.transport, srank, tags.PARAM, live=self.live,
+            out=out if wire is None else wire,
+        )
+        if got is not None and wire is not None:
+            self.codec.decode_into(wire, out)
 
     def _send_param(self, srank: int, shard: Shard):
-        """Whole-shard write, await ack (reference pclient.lua:60-70)."""
+        """Whole-shard write, await ack (reference pclient.lua:60-70).
+        No residual: parameter pushes (seeding / single-worker mirror)
+        are one-shot state transfers, not an accumulating signal."""
         view = self.param[shard.offset : shard.end]
-        yield from aio_send(self.transport, view, srank, tags.PARAM_PUSH, live=self.live)
+        payload = self._encode(view, self._param_wire.get(srank))
+        yield from aio_send(self.transport, payload, srank, tags.PARAM_PUSH, live=self.live)
         yield from aio_recv(self.transport, srank, tags.PARAM_PUSH_ACK, live=self.live)
+
+    def _encode(self, view: np.ndarray, wire: Optional[np.ndarray],
+                residual: Optional[np.ndarray] = None) -> np.ndarray:
+        """The slice itself for the identity codec (zero-copy send);
+        otherwise the encoded frame in the per-server staging buffer."""
+        if wire is None:
+            return view
+        self.codec.encode_into(view, wire, residual=residual)
+        return wire
+
+    def residual_norm(self) -> float:
+        """L2 norm of the error-feedback residuals across shards — 0.0
+        for residual-free codecs.  Observability/test hook."""
+        if not self._residual:
+            return 0.0
+        return float(np.sqrt(sum(
+            float(np.dot(r, r)) for r in self._residual.values()
+        )))
 
     # -- public async API (reference pclient.lua:84-109) --------------------
 
     def _enqueue(self, srank: int, gen: Generator, name: str) -> None:
         queue = self._opq.setdefault(srank, deque())
-        queue.append(gen)
+        queue.append((gen, name))
         if not self._pump_live.get(srank, False):
             self._pump_live[srank] = True
-            self.sched.spawn(self._pump(srank), name=f"pump:{srank}:{name}")
+            self._pump_task[srank] = None
+            task = self.sched.spawn(self._pump(srank), name=f"pump:{srank}:{name}")
+            self._pump_task[srank] = task
 
     def _pump(self, srank: int):
-        """Run this server's queued ops strictly in order."""
+        """Run this server's queued ops strictly in order, renaming the
+        task per dequeued op — a pump that kept its spawn-time name
+        (e.g. ``pump:3:send_grad``) for life would misattribute every
+        later op in scheduler error/debug output."""
         queue = self._opq[srank]
         try:
             while queue:
-                op = queue.popleft()
+                op, opname = queue.popleft()
+                task = self._pump_task.get(srank)
+                if task is not None:
+                    task.name = f"pump:{srank}:{opname}"
                 yield from op
         finally:
             self._pump_live[srank] = False

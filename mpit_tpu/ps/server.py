@@ -24,17 +24,28 @@ mechanics:
   pserver.lua:74) maps to serve-latest-committed: ``send_param`` snapshots
   the current immutable device array — writers are never quiesced, and no
   torn read is possible.
+
+Wire codecs (beyond-reference): each client negotiates a codec in its
+INIT v2 announcement (mpit_tpu/comm/codec.py; the 16-byte legacy INIT
+means 'none').  Gradient frames are decoded *inside* the jitted shard
+update — ``decode(wire) -> rule.apply`` is one XLA program, so the
+quantized path keeps today's one-call-per-grad shape.  Parameter reads
+are served from a **version-counted encoded snapshot cache**: the
+version bumps on every apply/seed, and N clients pulling the same
+committed version cost one device->host copy plus one encode, not N
+(``snapshot_copies`` / ``snapshot_hits`` count the win).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from mpit_tpu.aio import LiveFlag, Scheduler, aio_recv, aio_send
+from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.optim.rules import ShardRule, make as make_rule
 from mpit_tpu.ps import tags
@@ -54,6 +65,8 @@ class ParamServer:
         ckpt_dir: Optional[str] = None,
         ckpt_interval: float = 30.0,
         device: str = "cpu",  # "cpu" (host role, reference-faithful) | "default"
+        codec: Optional[str] = None,  # None: adopt each client's announcement;
+        #                               a name pins it — mismatches fail loudly
     ):
         self.rank = rank
         self.cranks = list(client_ranks)
@@ -72,8 +85,31 @@ class ParamServer:
         self.param: Optional[jnp.ndarray] = None  # device-resident shard
         self.rule_state = None
         self.grad_bufs: Dict[int, np.ndarray] = {}  # host recv staging, per client
-        self._param_staging: Optional[np.ndarray] = None
         self._stopped_clients = 0
+        # Codec negotiation state (INIT v2).  codec=None adopts whatever
+        # each client announces (per-pair negotiation — mixed-codec
+        # gangs are legal); an explicit name validates every
+        # announcement against it and raises on mismatch rather than
+        # decoding frames with the wrong codec.
+        if codec:  # fail at construction, not first INIT
+            codec_mod.get(codec)
+        self._codec_pin = codec or None
+        self._codecs: Dict[int, codec_mod.Codec] = {}
+        self._grad_views: Dict[int, List[np.ndarray]] = {}
+        self._push_bufs: Dict[int, np.ndarray] = {}
+        self._push_host: Dict[int, np.ndarray] = {}
+        self._apply_cache: Dict[str, Callable] = {}
+        # Version-counted snapshot cache: _snap_version bumps on every
+        # committed write (grad apply / seed / restore); _snap_host is
+        # the one device->host copy for that version and _snap_wire the
+        # per-codec encoded frame.  Serving allocates a fresh frame per
+        # version — an in-flight zero-copy send of the previous version
+        # must never see its buffer rewritten.
+        self._snap_version = 0
+        self._snap_host: Optional[Tuple[int, np.ndarray]] = None
+        self._snap_wire: Dict[str, Tuple[int, np.ndarray]] = {}
+        self.snapshot_copies = 0  # device->host copies actually performed
+        self.snapshot_hits = 0  # PARAM serves satisfied from the cache
         if device not in ("cpu", "default"):
             raise ValueError(f"device must be 'cpu' or 'default', got {device!r}")
         self._device = None
@@ -93,7 +129,6 @@ class ParamServer:
         # Placement discipline: every jnp array this server creates is
         # built inside _dev_ctx(), so shard + optimizer state live (and
         # the jitted apply runs) on the configured backend.
-        self._apply = jax.jit(self.rule.apply)
         self.grads_applied = 0
         self.params_served = 0
         self._restored = False
@@ -111,37 +146,142 @@ class ParamServer:
             return contextlib.nullcontext()
         return jax.default_device(self._device)
 
-    # -- service generators (reference pserver.lua coroutines) --------------
+    # -- codec plumbing ------------------------------------------------------
 
-    def _recv_init(self, crank: int):
-        """Receive [offset, size]; allocate shard state (reference :33-57)."""
-        payload = yield from aio_recv(self.transport, crank, tags.INIT, live=self.live)
-        if payload is None:
-            return
-        offset, size = (int(x) for x in np.frombuffer(payload, dtype=np.int64))
+    def _negotiate(self, crank: int, payload: bytes) -> "codec_mod.Codec":
+        """Parse the INIT announcement (v1 or v2) into (offset, size) on
+        self and the negotiated codec for this client.  Every failure
+        here is loud — a codec disagreement must never reach the frame
+        decoders, where it would corrupt parameters silently."""
+        raw = np.frombuffer(payload, dtype=np.int64)
+        if raw.size == 2:  # legacy 16-byte v1 announcement
+            offset, size, wire_id = int(raw[0]), int(raw[1]), 0
+        elif raw.size == 3:
+            offset, size, wire_id = (int(x) for x in raw)
+        else:
+            raise ValueError(
+                f"client {crank} INIT announcement is {len(payload)} bytes; "
+                "expected 16 (legacy [offset, size]) or 24 "
+                "([offset, size, codec_id])"
+            )
+        codec = codec_mod.by_wire_id(wire_id)
+        if self._codec_pin is not None and codec.name != self._codec_pin:
+            raise ValueError(
+                f"codec negotiation mismatch: client {crank} announced "
+                f"{codec.name!r} but server {self.rank} is pinned to "
+                f"{self._codec_pin!r} — align MPIT_PS_CODEC (or the codec "
+                "config) across the gang"
+            )
+        if not codec.identity and np.dtype(self.dtype) != np.float32:
+            raise ValueError(
+                f"codec {codec.name!r} quantizes float32 shards; server "
+                f"{self.rank} holds dtype {np.dtype(self.dtype).name} "
+                "(use codec='none' for other dtypes)"
+            )
         if self.offset == -1:
             self.offset, self.size = offset, size
             with self._dev_ctx():
                 self.param = jnp.zeros((size,), dtype=self.dtype)
                 self.rule_state = self.rule.init(self.param)
-            self._param_staging = np.zeros((size,), dtype=self.dtype)
         else:
             # All clients must agree on this server's shard (reference :87-88).
             assert (self.offset, self.size) == (offset, size), (
                 f"client {crank} announced shard ({offset},{size}) but server "
                 f"{self.rank} already holds ({self.offset},{self.size})"
             )
-        self.grad_bufs[crank] = np.zeros((size,), dtype=self.dtype)
+        return codec
+
+    def _apply_for(self, codec: "codec_mod.Codec") -> Callable:
+        """The jitted shard update for one codec: frame decode fused with
+        ``rule.apply`` into a single XLA program (one call per grad, same
+        as the fp32 path)."""
+        fn = self._apply_cache.get(codec.name)
+        if fn is None:
+            rule_apply = self.rule.apply
+            if codec.identity:
+                fn = jax.jit(rule_apply)
+            else:
+                size = self.size
+
+                def _decode_apply(param, parts, state):
+                    return rule_apply(param, codec.decode_parts(parts, size), state)
+
+                fn = jax.jit(_decode_apply)
+            self._apply_cache[codec.name] = fn
+        return fn
+
+    def _push_staging(self, crank: int) -> np.ndarray:
+        """Lazily-allocated PARAM_PUSH recv staging for one client, sized
+        to its codec's wire format (cold path: seeding / single mode)."""
+        buf = self._push_bufs.get(crank)
+        if buf is None:
+            codec = self._codecs[crank]
+            if codec.identity:
+                buf = np.zeros((self.size,), dtype=self.dtype)
+            else:
+                buf = np.zeros(codec.wire_nbytes(self.size), np.uint8)
+                self._push_host[crank] = np.zeros((self.size,), np.float32)
+            self._push_bufs[crank] = buf
+        return buf
+
+    def _committed(self) -> None:
+        """A new shard version exists (grad applied / params seeded)."""
+        self._snap_version += 1
+
+    def _snapshot_wire(self, codec: "codec_mod.Codec") -> np.ndarray:
+        """The current version's PARAM frame for ``codec``, cached: N
+        clients reading one committed version share one device->host
+        copy and one encode.  Runs between scheduler yields, so version
+        read + copy + encode are atomic w.r.t. grad applies."""
+        version = self._snap_version
+        cached = self._snap_wire.get(codec.name)
+        if cached is not None and cached[0] == version:
+            self.snapshot_hits += 1
+            return cached[1]
+        if self._snap_host is None or self._snap_host[0] != version:
+            # Serve-latest-committed: np.asarray snapshots the current
+            # immutable device array (the one device->host copy).
+            self._snap_host = (version, np.asarray(self.param))
+            self.snapshot_copies += 1
+        host = self._snap_host[1]
+        if codec.identity:
+            wire = host
+        else:
+            wire = np.empty(codec.wire_nbytes(self.size), np.uint8)
+            codec.encode_into(host, wire)
+        self._snap_wire[codec.name] = (version, wire)
+        return wire
+
+    # -- service generators (reference pserver.lua coroutines) --------------
+
+    def _recv_init(self, crank: int):
+        """Receive [offset, size(, codec_id)]; negotiate the codec and
+        allocate shard + staging state (reference :33-57)."""
+        payload = yield from aio_recv(self.transport, crank, tags.INIT, live=self.live)
+        if payload is None:
+            return
+        codec = self._negotiate(crank, payload)
+        self._codecs[crank] = codec
+        if codec.identity:
+            self.grad_bufs[crank] = np.zeros((self.size,), dtype=self.dtype)
+        else:
+            buf = np.zeros(codec.wire_nbytes(self.size), np.uint8)
+            self.grad_bufs[crank] = buf
+            self._grad_views[crank] = codec.split_wire(buf, self.size)
 
     def _recv_param(self, crank: int, once: bool = True,
                     warn_unexpected: bool = False):
         """Whole-shard write from a client: one-shot seeding from the first
         client (reference :92-102) or perpetual in single mode (the
         BiCNN recvparam_always service, BiCNN/pserver.lua:220-232)."""
+        codec = self._codecs.get(crank)
+        if codec is None:  # init never completed (stopped before announce)
+            return
+        staging = self._push_staging(crank)
         while self.live.on:
             got = yield from aio_recv(
                 self.transport, crank, tags.PARAM_PUSH,
-                live=self.live, out=self._param_staging,
+                live=self.live, out=staging,
             )
             if got is None:
                 return
@@ -151,8 +291,14 @@ class ParamServer:
                     "params overwritten (optimizer state kept) — start "
                     "resume clients with seed_servers=False", crank,
                 )
+            if codec.identity:
+                host = staging
+            else:  # cold path: host decode, then one h2d
+                host = self._push_host[crank]
+                codec.decode_into(staging, host)
             with self._dev_ctx():
-                self.param = jnp.asarray(self._param_staging)
+                self.param = jnp.asarray(host)
+            self._committed()
             yield from aio_send(
                 self.transport, tags.EMPTY, crank, tags.PARAM_PUSH_ACK, live=self.live
             )
@@ -160,8 +306,11 @@ class ParamServer:
                 return
 
     def _send_param(self, crank: int):
-        """Loop: await 0-byte read request, send current snapshot
-        (reference :59-72)."""
+        """Loop: await 0-byte read request, send the current version's
+        encoded snapshot (reference :59-72)."""
+        codec = self._codecs.get(crank)
+        if codec is None:  # init never completed (stopped before announce)
+            return
         while self.live.on:
             got = yield from aio_recv(
                 self.transport, crank, tags.PARAM_REQ, live=self.live
@@ -169,18 +318,21 @@ class ParamServer:
             if got is None:
                 return
             if self.live.io:
-                # Serve-latest-committed: np.asarray snapshots the current
-                # immutable device array (device->host copy).
-                snapshot = np.asarray(self.param)
+                snapshot = self._snapshot_wire(codec)
                 yield from aio_send(
                     self.transport, snapshot, crank, tags.PARAM, live=self.live
                 )
                 self.params_served += 1
 
     def _recv_grad(self, crank: int):
-        """Loop: receive gradient, apply the shard rule, ack
-        (reference :75-90 — the server hot loop)."""
+        """Loop: receive gradient frame, decode+apply the shard rule in
+        one jitted call, ack (reference :75-90 — the server hot loop)."""
+        codec = self._codecs.get(crank)
+        if codec is None:  # init never completed (stopped before announce)
+            return
         gbuf = self.grad_bufs[crank]
+        parts = self._grad_views.get(crank)
+        apply_fn = self._apply_for(codec)
         while self.live.on:
             got = yield from aio_recv(
                 self.transport, crank, tags.GRAD, live=self.live, out=gbuf
@@ -188,10 +340,15 @@ class ParamServer:
             if got is None:
                 return
             with self._dev_ctx():
-                self.param, self.rule_state = self._apply(
-                    self.param, jnp.asarray(gbuf), self.rule_state
+                if parts is None:
+                    grad_in: Any = jnp.asarray(gbuf)
+                else:
+                    grad_in = [jnp.asarray(v) for v in parts]
+                self.param, self.rule_state = apply_fn(
+                    self.param, grad_in, self.rule_state
                 )
             self.grads_applied += 1
+            self._committed()
             if self.live.on:
                 yield from aio_send(
                     self.transport, tags.EMPTY, crank, tags.GRAD_ACK, live=self.live
@@ -244,7 +401,7 @@ class ParamServer:
                 self.rule_state = {k: jnp.asarray(v) for k, v in state.items()}
             else:  # stateless rule (plain add) or legacy checkpoint
                 self.rule_state = self.rule.init(self.param)
-        self._param_staging = np.zeros((size,), dtype=self.dtype)
+        self._committed()
         self._restored = True
 
     def _serve_with_checkpoints(self) -> None:
@@ -306,7 +463,10 @@ class ParamServer:
         else:
             self.sched.wait()
         self.log.debug(
-            "stopped: %d grads applied, %d params served",
+            "stopped: %d grads applied, %d params served "
+            "(%d snapshot copies, %d cache hits)",
             self.grads_applied,
             self.params_served,
+            self.snapshot_copies,
+            self.snapshot_hits,
         )

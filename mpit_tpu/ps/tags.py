@@ -7,12 +7,17 @@ a client sends to request a shard read (reference pclient.lua:74-75 ->
 pserver.lua:100-101); *_ACK are the "tail" completion acks after writes
 (reference pserver.lua:85-86, pclient.lua:55-56)."""
 
-INIT = 1  # client -> server: int64 [offset, size] shard announcement
-GRAD = 2  # client -> server: gradient/delta bytes for the shard
+INIT = 1  # client -> server: int64 [offset, size, codec_id] shard
+#           announcement (INIT v2).  The 16-byte legacy v1 payload
+#           [offset, size] is still accepted and means codec_id=0
+#           ('none').  codec_id values: mpit_tpu/comm/codec.py wire ids;
+#           unknown ids fail loudly at the server.  See docs/PROTOCOL.md.
+GRAD = 2  # client -> server: gradient/delta frame for the shard, in the
+#           negotiated codec's wire format (raw dtype bytes for 'none')
 GRAD_ACK = 3  # server -> client: 0-byte ack after the update is applied
 PARAM_REQ = 4  # client -> server: 0-byte request-to-read header
-PARAM = 5  # server -> client: current shard snapshot
-PARAM_PUSH = 6  # client -> server: whole-shard parameter write
+PARAM = 5  # server -> client: current shard snapshot frame (negotiated codec)
+PARAM_PUSH = 6  # client -> server: whole-shard parameter write frame
 PARAM_PUSH_ACK = 7  # server -> client: 0-byte ack after the write lands
 STOP = 8  # client -> server: 0-byte graceful-shutdown signal
 

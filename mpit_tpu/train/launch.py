@@ -60,6 +60,12 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     server_ckpt_dir="",
     server_ckpt_interval=30.0,
     resume=False,
+    # Wire codec for every client<->server shard transfer (comm/codec.py:
+    # none | bf16 | int8).  "" defers to $MPIT_PS_CODEC (default none).
+    # When set explicitly the servers are PINNED to it, so a rank whose
+    # environment disagrees fails its INIT loudly instead of training on
+    # corrupt frames.
+    codec="",
 )
 
 
@@ -132,6 +138,7 @@ def run_rank(
             single_mode=single_mode, dtype=cfg.get("dtype", "float32"),
             ckpt_dir=ckpt_dir or None,
             ckpt_interval=float(cfg.get("server_ckpt_interval", 30.0)),
+            codec=str(cfg.get("codec", "") or "") or None,
         )
         if bool(cfg.get("resume", False)):
             import pathlib
@@ -157,6 +164,7 @@ def run_rank(
     pclient = ParamClient(
         rank, sranks, transport,
         seed_servers=(rank == cranks[0]) and not bool(cfg.get("resume", False)),
+        codec=str(cfg.get("codec", "") or "") or None,
     )
     trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
     log.info("worker with servers %s", sranks)

@@ -38,7 +38,8 @@ def run_tester(
     dtype = resolve_dtype(cfg.get("dtype", "float32"))
     param = np.zeros(plong, dtype)
     grad = np.zeros_like(param)
-    pclient = ParamClient(rank, server_ranks, transport, seed_servers=False)
+    pclient = ParamClient(rank, server_ranks, transport, seed_servers=False,
+                          codec=str(cfg.get("codec", "") or "") or None)
     pclient.start(param, grad)
 
     rounds = int(cfg.get("tester_rounds", 10))

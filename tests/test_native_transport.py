@@ -139,6 +139,110 @@ class TestShmTransport:
         assert t1 >= t0
 
 
+class TestShmCancelAndProbe:
+    """Focused coverage for ShmTransport.cancel/iprobe (comm/shm.py) —
+    the shutdown path (reference init.lua:50-58) and the probe-then-recv
+    rendezvous the aio schedulers rely on."""
+
+    def test_iprobe_lifecycle(self):
+        """False before arrival, true once assembled, false after the
+        matching recv drains it."""
+        a, b = pair(f"t_ip_{os.getpid()}")
+        try:
+            assert not b.iprobe(0, 31)
+            a.send(np.ones(4, np.float32), 1, 31)
+            while not b.iprobe(0, 31):
+                pass
+            assert b.iprobe(0, 31)  # idempotent: probing consumes nothing
+            out = np.zeros(4, np.float32)
+            b.recv(0, 31, out=out)
+            assert not b.iprobe(0, 31)
+        finally:
+            a.close()
+            b.close()
+
+    def test_iprobe_is_src_and_tag_selective(self):
+        a, b = pair(f"t_is_{os.getpid()}")
+        try:
+            a.send(b"x", 1, 41)
+            while not b.iprobe(0, 41):
+                pass
+            assert not b.iprobe(0, 42)  # different tag
+            assert not a.iprobe(1, 41)  # different endpoint/direction
+        finally:
+            a.close()
+            b.close()
+
+    def test_cancelled_recv_leaves_message_for_next_recv(self):
+        """cancel releases the native op; the queued message must still
+        serve a later correctly-posted receive."""
+        a, b = pair(f"t_cl_{os.getpid()}")
+        try:
+            pending = b.irecv(0, 51, out=np.zeros(2, np.float32))
+            b.cancel(pending)
+            a.send(np.asarray([3.0, 4.0], np.float32), 1, 51)
+            out = np.zeros(2, np.float32)
+            b.recv(0, 51, out=out)
+            np.testing.assert_array_equal(out, [3.0, 4.0])
+            assert pending.cancelled and not b.test(pending)
+        finally:
+            a.close()
+            b.close()
+
+    def test_cancel_after_completion_keeps_done(self):
+        """cancel on a tested-done handle is a no-op for correctness:
+        test stays True (idempotent completion caching) and nothing
+        double-releases natively."""
+        a, b = pair(f"t_cd_{os.getpid()}")
+        try:
+            data = np.ones(2, np.float32)
+            hs = a.isend(data, 1, 61)
+            out = np.zeros(2, np.float32)
+            hr = b.irecv(0, 61, out=out)
+            while not (a.test(hs) and b.test(hr)):
+                pass
+            a.cancel(hs)
+            b.cancel(hr)
+            assert a.test(hs) and b.test(hr)
+            np.testing.assert_array_equal(out, data)
+        finally:
+            a.close()
+            b.close()
+
+    def test_cancelled_send_ownership_released(self):
+        """cancel drops the transport's buffer reference (the liveness
+        contract's release half) and test reports not-done."""
+        a, b = pair(f"t_co_{os.getpid()}")
+        try:
+            # Clog the 64 KiB ring so the second send stays in flight.
+            big = np.ones(1 << 16, np.uint8)
+            h1 = a.isend(big, 1, 71)
+            h2 = a.isend(np.ones(8, np.float32), 1, 72)
+            a.cancel(h2)
+            assert h2.cancelled and h2.buf is None
+            assert not a.test(h2)
+            # The clogged first message still completes once drained.
+            out = np.zeros(1 << 16, np.uint8)
+            b.recv(0, 71, out=out)
+            while not a.test(h1):
+                pass
+        finally:
+            a.close()
+            b.close()
+
+    def test_non_contiguous_send_rejected(self):
+        """Satellite regression (zero-copy rule): the shm transport must
+        refuse a non-contiguous send buffer like as_bytes_view does, not
+        silently detach from the caller's memory."""
+        a, b = pair(f"t_nc_{os.getpid()}")
+        try:
+            with pytest.raises(ValueError, match="C-contiguous"):
+                a.isend(np.arange(16, dtype=np.float32)[::2], 1, 81)
+        finally:
+            a.close()
+            b.close()
+
+
 ECHO_PEER = textwrap.dedent(
     """
     import sys, numpy as np

@@ -40,24 +40,28 @@ class TestShardLayout:
 
 
 @contextlib.contextmanager
-def launch(nservers, nclients, rule="add", single_mode=False):
+def launch(nservers, nclients, rule="add", single_mode=False, codec=None,
+           server_codec=None):
     """PS topology: servers on ranks [0, nservers) in threads, clients on
     the following ranks, driven by the caller.  Teardown force-stops any
     still-running server so a failed assertion can't leave busy-spinning
-    threads behind to starve later tests."""
+    threads behind to starve later tests.  ``codec`` sets the clients'
+    announced codec; ``server_codec`` pins the servers (mismatch tests)."""
     n = nservers + nclients
     router = LocalRouter(n)
     sranks = list(range(nservers))
     cranks = list(range(nservers, n))
     servers = [
-        ParamServer(r, cranks, router.endpoint(r), rule=rule, single_mode=single_mode)
+        ParamServer(r, cranks, router.endpoint(r), rule=rule,
+                    single_mode=single_mode, codec=server_codec)
         for r in sranks
     ]
     threads = [threading.Thread(target=s.start, daemon=True) for s in servers]
     for t in threads:
         t.start()
     clients = [
-        ParamClient(r, sranks, router.endpoint(r), seed_servers=(r == cranks[0]))
+        ParamClient(r, sranks, router.endpoint(r),
+                    seed_servers=(r == cranks[0]), codec=codec)
         for r in cranks
     ]
     try:
@@ -243,6 +247,252 @@ class TestPSWithOptimizers:
             join_all(threads)
             np.testing.assert_allclose(
                 np.asarray(servers[0].param), np.asarray(w), rtol=1e-5)
+
+
+class TestWireCodecs:
+    """INIT v2 negotiation, quantized transfers, the snapshot cache, and
+    the fail-loudly paths (legacy interop / mismatch / unknown id)."""
+
+    @pytest.mark.parametrize("codec,tol", [("bf16", 2.0**-7), ("int8", 1 / 64)])
+    def test_seed_push_pull_quantized(self, rng, codec, tol):
+        w0 = rng.normal(size=3000).astype(np.float32)
+        with launch(2, 1, codec=codec) as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+            grad[:] = 1.0
+            client.async_send_grad()
+            client.async_recv_param()
+            client.wait()
+            scale = np.abs(w0).max() + 1.0
+            # seed + grad + snapshot each quantize once
+            np.testing.assert_allclose(param, w0 + 1.0, atol=4 * tol * scale)
+            client.stop()
+            join_all(threads)
+            assert all(s._codecs[2].name == codec for s in servers)
+
+    def test_env_codec_drives_negotiation(self, rng, monkeypatch):
+        monkeypatch.setenv("MPIT_PS_CODEC", "bf16")
+        w0 = rng.normal(size=64).astype(np.float32)
+        with launch(1, 1) as (servers, (client,), threads):
+            assert client.codec.name == "bf16"
+            client.start(w0.copy(), np.zeros_like(w0))
+            client.stop()
+            join_all(threads)
+            assert servers[0]._codecs[1].name == "bf16"
+
+    def test_legacy_16_byte_init_interops_as_none(self, rng):
+        """A v1 peer announcing [offset, size] must be served with the
+        identity codec — the mixed-version deployment case."""
+        w0 = rng.normal(size=16).astype(np.float32)
+        router = LocalRouter(2)
+        server = ParamServer(0, [1], router.endpoint(0))
+        t = threading.Thread(target=server.start, daemon=True)
+        t.start()
+        try:
+            wire = router.endpoint(1)
+            from mpit_tpu.ps import tags
+
+            # Hand-rolled v1 client: legacy INIT, seed, grad, pull.
+            wire.send(np.asarray([0, 16], dtype=np.int64), 0, tags.INIT)
+            wire.send(w0, 0, tags.PARAM_PUSH)
+            wire.recv(0, tags.PARAM_PUSH_ACK)
+            wire.send(np.full(16, 2.0, np.float32), 0, tags.GRAD)
+            wire.recv(0, tags.GRAD_ACK)
+            wire.send(tags.EMPTY, 0, tags.PARAM_REQ)
+            out = np.zeros(16, np.float32)
+            while not wire.iprobe(0, tags.PARAM):
+                pass
+            wire.recv(0, tags.PARAM, out=out)
+            np.testing.assert_allclose(out, w0 + 2.0, rtol=1e-6)
+            assert server._codecs[1].name == "none"
+            wire.send(tags.EMPTY, 0, tags.STOP)
+            join_all([t])
+        finally:
+            server.live.stop()
+
+    def test_codec_mismatch_fails_loudly(self, rng):
+        """A server pinned to one codec must reject a client announcing
+        another at INIT — not decode frames into corrupt params."""
+        from mpit_tpu.aio.scheduler import TaskError
+
+        n = 2
+        router = LocalRouter(n)
+        server = ParamServer(0, [1], router.endpoint(0), codec="bf16")
+        failure = []
+
+        def run_server():
+            try:
+                server.start()
+            except TaskError as exc:
+                failure.append(exc)
+
+        t = threading.Thread(target=run_server, daemon=True)
+        t.start()
+        client = ParamClient(1, [0], router.endpoint(1), codec="int8")
+        w0 = rng.normal(size=8).astype(np.float32)
+        client.start(w0.copy(), np.zeros_like(w0))  # INIT only (no seeding)
+        t.join(10)
+        assert not t.is_alive(), "mismatched server neither failed nor stopped"
+        assert failure, "server accepted a mismatched codec announcement"
+        assert "codec negotiation mismatch" in str(failure[0].cause)
+
+    def test_unknown_wire_id_fails_loudly(self):
+        from mpit_tpu.aio.scheduler import TaskError
+        from mpit_tpu.ps import tags
+
+        router = LocalRouter(2)
+        server = ParamServer(0, [1], router.endpoint(0))
+        failure = []
+
+        def run_server():
+            try:
+                server.start()
+            except TaskError as exc:
+                failure.append(exc)
+
+        t = threading.Thread(target=run_server, daemon=True)
+        t.start()
+        router.endpoint(1).send(
+            np.asarray([0, 8, 99], dtype=np.int64), 0, tags.INIT)
+        t.join(10)
+        assert not t.is_alive()
+        assert failure and "unknown codec wire id" in str(failure[0].cause)
+
+    def test_bad_init_length_fails_loudly(self):
+        from mpit_tpu.aio.scheduler import TaskError
+        from mpit_tpu.ps import tags
+
+        router = LocalRouter(2)
+        server = ParamServer(0, [1], router.endpoint(0))
+        failure = []
+
+        def run_server():
+            try:
+                server.start()
+            except TaskError as exc:
+                failure.append(exc)
+
+        t = threading.Thread(target=run_server, daemon=True)
+        t.start()
+        router.endpoint(1).send(
+            np.asarray([0, 8, 0, 0], dtype=np.int64), 0, tags.INIT)
+        t.join(10)
+        assert not t.is_alive()
+        assert failure and "INIT announcement" in str(failure[0].cause)
+
+    def test_snapshot_cache_one_copy_per_version(self, rng):
+        """N pulls of one committed version = one device->host copy +
+        one encode; a grad apply bumps the version and invalidates."""
+        w0 = rng.normal(size=256).astype(np.float32)
+        with launch(1, 1, codec="int8") as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+            for _ in range(3):  # same version three times
+                client.async_recv_param()
+                client.wait()
+            s = servers[0]
+            assert s.snapshot_copies == 1
+            assert s.snapshot_hits == 2
+            grad[:] = 1.0
+            client.async_send_grad()
+            client.wait()
+            client.async_recv_param()
+            client.wait()
+            assert s.snapshot_copies == 2  # new version, one new copy
+            client.stop()
+            join_all(threads)
+
+    def test_mixed_codec_clients_negotiate_per_pair(self, rng):
+        """codec=None servers adopt each client's announcement — a bf16
+        client and a none client share one server."""
+        w0 = rng.normal(size=128).astype(np.float32)
+        n = 3
+        router = LocalRouter(n)
+        server = ParamServer(0, [1, 2], router.endpoint(0))
+        t = threading.Thread(target=server.start, daemon=True)
+        t.start()
+        c1 = ParamClient(1, [0], router.endpoint(1), seed_servers=True,
+                         codec="none")
+        c2 = ParamClient(2, [0], router.endpoint(2), codec="bf16")
+        p1, g1 = w0.copy(), np.zeros_like(w0)
+        p2, g2 = np.zeros_like(w0), np.zeros_like(w0)
+        t1 = threading.Thread(target=c1.start, args=(p1, g1), daemon=True)
+        t2 = threading.Thread(target=c2.start, args=(p2, g2), daemon=True)
+        t1.start(); t2.start()
+        t1.join(30); t2.join(30)
+        assert not t1.is_alive() and not t2.is_alive(), "client start hung"
+        c2.async_recv_param()
+        c2.wait()
+        np.testing.assert_allclose(p2, w0, rtol=2.0**-7, atol=1e-6)
+        assert server._codecs[1].name == "none"
+        assert server._codecs[2].name == "bf16"
+        c1.stop(); c2.stop()
+        join_all([t])
+
+    def test_int8_error_feedback_sums_over_rounds(self, rng):
+        """Repeated identical grads must accumulate to ~T*g on the server
+        (EF re-ships each round's quantization error), far tighter than
+        T independent quantization errors."""
+        w0 = np.zeros(2048, np.float32)
+        g = rng.normal(size=2048).astype(np.float32)
+        T = 16
+        with launch(1, 1, codec="int8") as (servers, (client,), threads):
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+            grad[:] = g
+            for _ in range(T):
+                client.async_send_grad()
+                client.wait()
+            client.async_recv_param()
+            client.wait()
+            client.stop()
+            join_all(threads)
+        # EF bound: |sum - T*g| <= residual + one snapshot quantization,
+        # each bounded by one block scale — NOT T * scale.
+        scale = np.abs(g).max() * T / 127.0
+        assert np.abs(param - T * g).max() <= 2.5 * scale
+        assert client.residual_norm() > 0.0  # residual is live
+
+    def test_residual_free_codecs_report_zero_norm(self, rng):
+        with launch(1, 1, codec="bf16") as (servers, (client,), threads):
+            client.start(np.ones(8, np.float32), np.zeros(8, np.float32))
+            assert client.residual_norm() == 0.0
+            client.stop()
+            join_all(threads)
+
+    def test_quantized_dtype_guard(self):
+        router = LocalRouter(2)
+        client = ParamClient(1, [0], router.endpoint(1), codec="int8")
+        with pytest.raises(ValueError, match="float32"):
+            client.start(np.zeros(8, np.float64), np.zeros(8, np.float64))
+
+
+class TestPumpTaskNaming:
+    def test_pump_name_refreshes_per_op(self, rng):
+        """The pump task must be renamed per dequeued op — a stale
+        spawn-time name misattributes later ops in error output."""
+        router = LocalRouter(2, delay=2)  # ops span scheduler steps
+        server = ParamServer(0, [1], router.endpoint(0))
+        t = threading.Thread(target=server.start, daemon=True)
+        t.start()
+        try:
+            client = ParamClient(1, [0], router.endpoint(1), seed_servers=True)
+            w0 = rng.normal(size=8).astype(np.float32)
+            param, grad = w0.copy(), np.zeros_like(w0)
+            client.start(param, grad)
+            names = set()
+            client.async_send_grad()
+            client.async_recv_param()
+            task = client._pump_task[0]
+            while client.sched.queue:
+                names.add(task.name)
+                client.ping()
+            assert "pump:0:send_grad" in names
+            assert "pump:0:recv_param" in names
+            client.stop()
+            join_all([t])
+        finally:
+            server.live.stop()
 
 
 class TestServerCheckpointResume:

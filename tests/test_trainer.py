@@ -127,6 +127,32 @@ class TestTopologies:
         assert len(workers) == 2
         assert all(w["final_test_err"] < 0.8 for w in workers)
 
+    def test_eamsgd_np4_int8_codec_converges(self, small_data):
+        """The flagship EASGD topology with quantized shard transfer
+        (codec=int8 pins the servers AND drives the clients) must reach
+        the same test-error bar as the uncompressed run above — the
+        client-held error-feedback residual carries the quantization
+        error across sync rounds."""
+        cfg = LAUNCH_DEFAULTS.merged(
+            np=4, opt="eamsgd", lr=0.2, mom=0.9, mva=0.45, su=5,
+            epochs=1, batch=64, side=8, codec="int8",
+        )
+        results = run_topology(4, cfg, small_data)
+        workers = [res for res in results.values() if res["role"] == "worker"]
+        assert len(workers) == 2
+        assert all(w["final_test_err"] < 0.8 for w in workers)
+        assert all(res["grads_applied"] > 0 for res in results.values()
+                   if res["role"] == "server")
+
+    def test_downpour_np4_bf16_codec(self, small_data):
+        cfg = LAUNCH_DEFAULTS.merged(
+            np=4, opt="downpour", lr=0.2, su=1, epochs=1, batch=64, side=8,
+            codec="bf16",
+        )
+        results = run_topology(4, cfg, small_data)
+        workers = [res for res in results.values() if res["role"] == "worker"]
+        assert all(w["final_test_err"] < 0.8 for w in workers)
+
     def test_tester_role(self, small_data, tmp_path):
         cfg = LAUNCH_DEFAULTS.merged(
             np=3, opt="downpour", lr=0.2, su=1, epochs=1, batch=64, side=8,
