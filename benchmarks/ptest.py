@@ -60,6 +60,16 @@ NCLIENTS = int(os.environ.get("MPIT_BENCH_CLIENTS", "2"))
 CODECS = [c for c in os.environ.get("MPIT_BENCH_CODECS", "").split(",") if c]
 REPS = max(int(os.environ.get("MPIT_BENCH_REPS", "1")), 1)
 GANG = os.environ.get("MPIT_BENCH_GANG", "procs")
+# MPIT_BENCH_HEARTBEAT=1: run each shm leg twice — heartbeats (and the
+# server lease registry) off, then on — and record the column, so the
+# liveness tax on the PS hot path is a measured number, not a guess.
+# Heartbeats only; FT frame headers (op deadlines) are a different mode
+# with a known staging-copy cost and are not part of this sweep.
+HEARTBEAT_SWEEP = os.environ.get("MPIT_BENCH_HEARTBEAT", "") not in ("", "0")
+# MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
+# (heartbeats on or off) lands below 97% of this reference — the
+# regression gate for the captured record (PR 2: 252.7 at 640 MB).
+BASELINE = float(os.environ.get("MPIT_BENCH_BASELINE", "0") or 0)
 
 
 def bench_ici() -> dict:
@@ -78,9 +88,10 @@ def bench_ici() -> dict:
     }
 
 
-def bench_shm(codec: str = "") -> dict:
+def bench_shm(codec: str = "", heartbeat: bool = False) -> dict:
     """One shm PS push/pull measurement; ``codec`` overrides
-    MPIT_PS_CODEC for the gang (read at client/server construction)."""
+    MPIT_PS_CODEC for the gang (read at client/server construction);
+    ``heartbeat`` arms client beacons + the server lease registry."""
     import numpy as np
 
     from mpit_tpu.comm import codec as codec_mod
@@ -90,17 +101,22 @@ def bench_shm(codec: str = "") -> dict:
     codec_name = codec_mod.get(codec or None).name
     size = int(MB * (1 << 20) / 4)
     _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, codec "
-         f"{codec_name}, payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
+         f"{codec_name}, heartbeat {'on' if heartbeat else 'off'}, "
+         f"payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
 
+    if heartbeat and GANG != "procs":
+        raise RuntimeError("MPIT_BENCH_HEARTBEAT needs MPIT_BENCH_GANG=procs")
     run = _shm_run_procs if GANG == "procs" else _shm_run_threads
-    runs = [run(size) for _ in range(REPS)]
+    runs = [run(size, heartbeat=heartbeat) for _ in range(REPS)]
     mbs = float(np.median(np.asarray(runs)))
-    _log(f"[shm] codec {codec_name}: median {mbs:.1f} MB/s over {runs}")
+    _log(f"[shm] codec {codec_name} hb={int(heartbeat)}: "
+         f"median {mbs:.1f} MB/s over {runs}")
     return {
         "metric": "ps_pushpull_bandwidth_shm",
         "value": round(mbs, 1),
         "unit": "MB/s",
         "codec": codec_name,
+        "heartbeat": int(heartbeat),
         "gang": GANG,
         "reps": REPS,
         "value_runs": [round(v, 1) for v in runs],
@@ -124,7 +140,7 @@ def _ring_bytes(size: int) -> int:
     return max(64 << 20, 2 * peers * shard_bytes + (16 << 20))
 
 
-def _shm_run_procs(size: int) -> float:
+def _shm_run_procs(size: int, heartbeat: bool = False) -> float:
     """One timed gang, one OS process per rank: servers run the PS serve
     loop, clients run T rounds of {pull, push, wait} and report their
     round-loop window; aggregate MB/s uses the union of the client
@@ -138,6 +154,7 @@ def _shm_run_procs(size: int) -> float:
     spec = {
         "ns": ns, "nservers": NSERVERS, "nclients": NCLIENTS,
         "size": size, "ring": _ring_bytes(size), "rounds": ROUNDS,
+        "heartbeat": int(heartbeat),
     }
     tmpdir = tempfile.mkdtemp(prefix=f"{ns}_")
     procs, result_files = [], []
@@ -200,6 +217,7 @@ def _gang_child() -> None:
 
     from mpit_tpu.comm.collectives import HostCollectives
     from mpit_tpu.comm.shm import ShmTransport
+    from mpit_tpu.ft import FTConfig
     from mpit_tpu.ps import ParamClient, ParamServer
 
     spec = json.loads(os.environ["PTEST_GANG"])
@@ -208,22 +226,34 @@ def _gang_child() -> None:
     sranks = list(range(spec["nservers"]))
     cranks = list(range(spec["nservers"], nranks))
     size = spec["size"]
+    heartbeat = bool(spec.get("heartbeat"))
+    # Explicit FTConfig either way: the A/B must measure the heartbeat
+    # machinery, not whatever MPIT_FT_* happens to be in the caller env.
+    # Very generous TTL: the sweep measures liveness *cost*, not
+    # eviction, and an oversubscribed bench host can starve a rank hard
+    # enough (observed: beats at 1/4 nominal rate at 640 MB) that a
+    # production-tight TTL evicts a live client mid-leg and wedges it.
+    client_ft = FTConfig(heartbeat_s=0.05) if heartbeat else FTConfig()
+    server_ft = FTConfig(lease_ttl_s=120.0) if heartbeat else FTConfig()
     transport = ShmTransport(spec["ns"], rank, nranks,
                              ring_bytes=spec["ring"])
     # Startup barrier: no PS traffic until every ring is mapped (the
     # mpirun-gives-you-this guarantee, same as train/gang.py).
     HostCollectives(transport).barrier()
     if rank in sranks:
-        server = ParamServer(rank, cranks, transport, rule="add")
+        server = ParamServer(rank, cranks, transport, rule="add",
+                             ft=server_ft)
         server.start()
         result = {
             "role": "server", "grads_applied": server.grads_applied,
             "snapshot_copies": server.snapshot_copies,
             "snapshot_hits": server.snapshot_hits,
+            "heartbeats_seen": server.heartbeats_seen,
         }
     else:
         client = ParamClient(rank, sranks, transport,
-                             seed_servers=(rank == cranks[0]))
+                             seed_servers=(rank == cranks[0]),
+                             ft=client_ft)
         param = np.zeros(size, np.float32)
         grad = np.full(size, 1e-6, np.float32)
         client.start(param, grad)
@@ -235,18 +265,22 @@ def _gang_child() -> None:
         # tag outside the PS/collectives ranges.
         client.async_recv_param()
         client.wait()
+        # The barrier spins pump client.ping(): with heartbeats on, a
+        # client parked here while a peer finishes its (multi-second at
+        # 640 MB) warmup pull must keep beating, or the lease registry
+        # evicts it mid-barrier and wedges the leg.
         _SYNC_TAG = 59999
         if rank == cranks[0]:
             for peer in cranks[1:]:
                 while not transport.iprobe(peer, _SYNC_TAG):
-                    pass
+                    client.ping()
                 transport.recv(peer, _SYNC_TAG)
             for peer in cranks[1:]:
                 transport.send(b"go", peer, _SYNC_TAG)
         else:
             transport.send(b"rdy", cranks[0], _SYNC_TAG)
             while not transport.iprobe(cranks[0], _SYNC_TAG):
-                pass
+                client.ping()
             transport.recv(cranks[0], _SYNC_TAG)
         t0 = time.time()
         for _ in range(spec["rounds"]):
@@ -261,7 +295,7 @@ def _gang_child() -> None:
         json.dump(result, fh)
 
 
-def _shm_run_threads(size: int) -> float:
+def _shm_run_threads(size: int, heartbeat: bool = False) -> float:
     """One timed gang: T rounds of {pull, push, wait} per client, all
     ranks as threads of this process (debug mode — see module docstring
     for why this understates codec throughput)."""
@@ -333,20 +367,33 @@ def _bench_shm_subprocess(codec: str = "") -> dict:
 def main():
     results = []
     sweep = CODECS or [""]
+    hb_modes = [False, True] if HEARTBEAT_SWEEP else [False]
     if MODE in ("ici", "both"):
         results.append(bench_ici())
     if MODE == "shm":
-        results.extend(bench_shm(c) for c in sweep)
+        results.extend(bench_shm(c, hb) for c in sweep for hb in hb_modes)
     elif MODE == "both":
         if GANG == "procs":
             # Every rank is its own child process with JAX_PLATFORMS=cpu;
             # this parent keeps the accelerator for the ici leg and never
             # touches jax on the shm path.
-            results.extend(bench_shm(c) for c in sweep)
+            results.extend(bench_shm(c, hb) for c in sweep for hb in hb_modes)
         else:
             results.extend(_bench_shm_subprocess(c) for c in sweep)
     for r in results:
         print(json.dumps(r))
+    if BASELINE > 0:
+        low = [
+            r for r in results
+            if r.get("codec") == "none" and r["metric"].endswith("_shm")
+            and r["value"] < 0.97 * BASELINE
+        ]
+        if low:
+            raise SystemExit(
+                f"codec=none throughput regression: {[r['value'] for r in low]}"
+                f" MB/s (heartbeat={[r.get('heartbeat') for r in low]}) below"
+                f" 97% of the {BASELINE} MB/s baseline"
+            )
 
 
 if __name__ == "__main__":

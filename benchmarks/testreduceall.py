@@ -145,7 +145,7 @@ def _shm_parent(nranks: int, timeout: float = 300.0) -> None:
 def main():
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from mpit_tpu.parallel.collective import shard_map  # version shim
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from mpit_tpu.utils.platform import default_devices

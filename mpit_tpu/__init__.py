@@ -31,6 +31,12 @@ L2    parameter-server protocol       mpit_tpu.ps
 L1    async engine (scheduler/queue)  mpit_tpu.aio
 L0    transports (native C++ / ICI)   mpit_tpu.comm
 ====  ==============================  ==========================================
+
+Cross-cutting: ``mpit_tpu.ft`` (fault tolerance — heartbeats/leases, op
+deadlines with dedup'd retry, checkpoint/rejoin, deterministic fault
+injection) threads through L0-L5, and ``mpit_tpu.analysis`` (mtlint)
+statically checks the protocol, concurrency, and hot-path invariants the
+other layers rely on.
 """
 
 __version__ = "0.1.0"

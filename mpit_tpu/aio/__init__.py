@@ -26,12 +26,15 @@ from mpit_tpu.aio.scheduler import (
     EXEC,
     INIT,
     OK,
+    DeadlineExceeded,
     LiveFlag,
     Scheduler,
     Task,
     TaskError,
     aio_recv,
     aio_send,
+    aio_sleep,
+    deadline_at,
 )
 
 __all__ = [
@@ -39,9 +42,12 @@ __all__ = [
     "Scheduler",
     "Task",
     "TaskError",
+    "DeadlineExceeded",
     "LiveFlag",
     "aio_send",
     "aio_recv",
+    "aio_sleep",
+    "deadline_at",
     "INIT",
     "EXEC",
     "OK",

@@ -28,6 +28,9 @@ RULES: Dict[str, Tuple[str, str]] = {
     "MT-P103": (ERROR, "write tag missing its *_ACK tail in the same function"),
     "MT-P104": (ERROR, "request/reply cycle where both roles block on recv"),
     "MT-P105": (ERROR, "comm/native specs drifted from the checked-in bindings"),
+    # -- bounded-wait discipline (the mpit_tpu.ft contract) ----------------
+    "MT-P201": (ERROR, "aio send/recv in a role file with no deadline=/abort= bound"),
+    "MT-P202": (ERROR, "blocking transport send/recv convenience in a role file"),
     # -- concurrency (locks, threads, scheduler contract) ------------------
     "MT-C201": (ERROR, "lock-order inversion (A->B here, B->A elsewhere)"),
     "MT-C202": (WARN, "blocking call while holding a lock"),

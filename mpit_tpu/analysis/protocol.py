@@ -20,6 +20,16 @@ rendezvous conventions).  This pass makes it machine-checked:
 - ``comm/native/specs/*.json`` is checked against the checked-in
   generated bindings by re-running the (stdlib-only) generator and
   comparing output — spec drift is MT-P105.
+
+The MT-P2xx family checks **bounded-wait discipline** (the mpit_tpu.ft
+contract): in a role file, every ``aio_send``/``aio_recv`` must carry an
+explicit ``deadline=`` or ``abort=`` keyword (MT-P201) — a bare ``live=``
+only covers orderly shutdown, not a dead peer — and the blocking
+``transport.send()``/``transport.recv()`` conveniences are flagged
+outright (MT-P202): they busy-spin with no bound at all.  Genuinely
+unbounded-by-design waits (the INIT rendezvous, the rejoin listener)
+carry mtlint.toml suppressions with reasons, which is the point: every
+unbounded wait in a role file is either a bug or a documented decision.
 """
 
 from __future__ import annotations
@@ -275,6 +285,43 @@ def _check_deadlock_shape(fns: List[RoleFn]) -> List[Finding]:
     return findings
 
 
+_BOUND_KWS = {"deadline", "abort"}
+_BLOCKING_RECEIVERS = {"transport", "wire"}
+
+
+def _check_deadline_discipline(files: List[SourceFile]) -> List[Finding]:
+    """MT-P201/MT-P202: unbounded blocking calls in role files."""
+    findings: List[Finding] = []
+    for src in files:
+        role = _role_of(src)
+        if role is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callee_name(node)
+            if name in ("aio_send", "aio_recv"):
+                if not (_BOUND_KWS & {kw.arg for kw in node.keywords}):
+                    findings.append(src.finding(
+                        "MT-P201", node.lineno,
+                        f"{name} in a {role} role file has neither "
+                        "deadline= nor abort= — a dead peer blocks this "
+                        "service forever (live= only covers orderly "
+                        "shutdown); bound it via mpit_tpu.ft or suppress "
+                        "with a reason"))
+            elif name in ("send", "recv") and isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                base = (recv.attr if isinstance(recv, ast.Attribute)
+                        else recv.id if isinstance(recv, ast.Name) else None)
+                if base in _BLOCKING_RECEIVERS:
+                    findings.append(src.finding(
+                        "MT-P202", node.lineno,
+                        f"blocking transport.{name}() in a {role} role "
+                        "file spins with no bound at all — use the aio "
+                        "generators with a deadline/abort"))
+    return findings
+
+
 def _check_spec_drift(files: List[SourceFile]) -> List[Finding]:
     findings: List[Finding] = []
     for src in files:
@@ -344,5 +391,6 @@ def check(files: List[SourceFile]) -> List[Finding]:
         findings += _check_pairing(table, tag_lines, fns)
         findings += _check_ack_discipline(table, fns)
         findings += _check_deadlock_shape(fns)
+    findings += _check_deadline_discipline(files)
     findings += _check_spec_drift(files)
     return findings

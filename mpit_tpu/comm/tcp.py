@@ -128,6 +128,7 @@ class TcpTransport(Transport):
         listener: Optional[socket.socket] = None,
         connect_timeout: float = 60.0,
         reconnect: Optional[float] = None,
+        dial_peers: Optional[Sequence[int]] = None,
     ):
         import os as _os
         import secrets
@@ -212,12 +213,33 @@ class TcpTransport(Transport):
         self._listener = listener
 
         # Dial lower ranks, accept higher ranks (deadlock-free full mesh).
+        # ``dial_peers`` (FT rejoin path) restricts construction to the
+        # connections this endpoint actually needs: a worker restarted
+        # mid-run must reach its *servers*, but a sibling worker may have
+        # finished and exited — demanding its listener would turn normal
+        # completion into a rejoin failure.  Skipped lower ranks are
+        # marked dead (sends fail loudly, not silently queue); skipped
+        # higher ranks arrive later through the persistent accept loop,
+        # which is why the restriction requires reconnect mode.
         deadline = time.monotonic() + connect_timeout
-        for peer in range(rank):
+        if dial_peers is None:
+            to_dial = list(range(rank))
+            n_accept = nranks - rank - 1
+        else:
+            if self.reconnect <= 0:
+                raise ValueError(
+                    "dial_peers needs reconnect mode (MPIT_TCP_RECONNECT_S"
+                    " > 0): undialed peers can only join via the "
+                    "persistent accept loop"
+                )
+            to_dial = sorted({int(p) for p in dial_peers} & set(range(rank)))
+            self._dead_peers.update(set(range(rank)) - set(to_dial))
+            n_accept = 0
+        for peer in to_dial:
             conn, pnonce, peer_last = self._dial(addresses[peer], deadline,
                                                  peer)
             self._install_socket(peer, conn, pnonce, peer_last)
-        for _ in range(nranks - rank - 1):
+        for _ in range(n_accept):
             conn, _addr = self._accept(deadline)
             conn.settimeout(None)  # accepted sockets must block
             got = self._handshake_accept(conn)

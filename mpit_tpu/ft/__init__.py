@@ -1,0 +1,49 @@
+"""mpit_tpu.ft — fault tolerance for the parameter-server gang.
+
+The EASGD/DOWNPOUR family's premise is loose coupling, but the pre-FT
+protocol was tightly coupled to every member's health: a hung client
+wedged its server's recv loops forever, a dropped message stalled the
+op pump, and a killed rank could never come back.  This package makes
+worker churn a handled event, in four pieces threaded through the
+existing layers:
+
+- **liveness** — HEARTBEAT beacons (ps/tags.py) into a server-side
+  :class:`LeaseRegistry`; expiry evicts the client (services unblock,
+  stop protocol completes without it) instead of waiting forever.
+- **deadlines + retry** — every PS op can carry a deadline
+  (aio/scheduler.py timers); timeouts resend the staged frame under a
+  :class:`RetryPolicy` (capped exponential backoff, deterministic
+  jitter), and the server's :class:`DedupTable` admits each framed op
+  at most once on ``(client, epoch, seq)`` (ft/wire.py).
+- **checkpoint / rejoin** — stamped atomic server snapshots carry the
+  dedup table; a restarted rank re-announces via INIT v3 with a bumped
+  epoch and resumes mid-run (ft/supervisor.py restarts dead ranks).
+- **fault injection** — :class:`FaultyTransport` forces drop / delay /
+  dup / sever deterministically (ft/faults.py), so every recovery path
+  above is exercised by replayable tier-1 tests.
+"""
+
+from mpit_tpu.ft.config import FTConfig
+from mpit_tpu.ft.dedup import DUP, FRESH, STALE, DedupTable
+from mpit_tpu.ft.faults import FaultPlan, FaultyTransport
+from mpit_tpu.ft.leases import ACTIVE, EVICTED, STOPPED, LeaseRegistry
+from mpit_tpu.ft.retry import RetryExhausted, RetryPolicy
+from mpit_tpu.ft.wire import (
+    FLAG_FRAMED,
+    FLAG_HEARTBEAT,
+    HDR_BYTES,
+    header_frame,
+    init_v3,
+    pack_header,
+    unpack_header,
+)
+
+__all__ = [
+    "FTConfig",
+    "DedupTable", "FRESH", "DUP", "STALE",
+    "FaultPlan", "FaultyTransport",
+    "LeaseRegistry", "ACTIVE", "EVICTED", "STOPPED",
+    "RetryPolicy", "RetryExhausted",
+    "HDR_BYTES", "FLAG_FRAMED", "FLAG_HEARTBEAT",
+    "pack_header", "unpack_header", "header_frame", "init_v3",
+]

@@ -1,0 +1,65 @@
+"""At-most-once admission for framed PS writes: dedup on (client, epoch,
+seq).
+
+Transports deliver each (src, tag) channel in FIFO order, and a client
+resends a timed-out op with its original seq — so per channel the server
+sees a non-decreasing seq stream where duplicates are retransmissions of
+ops it may already have applied.  One (epoch, last_seq) pair per channel
+is therefore a complete dedup state: no windowed history needed.
+
+Verdicts:
+
+- ``FRESH`` — first sighting; apply, then ack.
+- ``DUP``   — same epoch, seq already admitted: skip the apply, but
+  *re-ack* — the duplicate exists precisely because the client may have
+  lost the first ack.  Skipping the apply is what keeps a retried GRAD
+  from double-counting (and keeps the client's error-feedback residual
+  telescope exact: the applied stream equals the encoded stream).
+- ``STALE`` — older epoch: a dead incarnation's leftover traffic.
+  Dropped without an ack; the live incarnation matches acks by epoch
+  and must never be fed an impostor.
+
+The table serializes to flat JSON (``state()``/``restore()``) so a
+server checkpoint carries it: after a server restart, a client retrying
+an op the old process already applied-and-checkpointed still gets DUP,
+not a second apply.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+FRESH = "fresh"
+DUP = "dup"
+STALE = "stale"
+
+
+class DedupTable:
+    def __init__(self) -> None:
+        #: (crank, tag) -> (epoch, last admitted seq)
+        self._last: Dict[Tuple[int, int], Tuple[int, int]] = {}
+
+    def admit(self, crank: int, tag: int, epoch: int, seq: int) -> str:
+        key = (crank, tag)
+        cur = self._last.get(key)
+        if cur is not None:
+            cur_epoch, cur_seq = cur
+            if epoch < cur_epoch:
+                return STALE
+            if epoch == cur_epoch and seq <= cur_seq:
+                return DUP
+        self._last[key] = (epoch, seq)
+        return FRESH
+
+    def last(self, crank: int, tag: int) -> "Tuple[int, int] | None":
+        return self._last.get((crank, tag))
+
+    # -- checkpoint round-trip (values live in JSON meta) --------------------
+
+    def state(self) -> Dict[str, list]:
+        return {f"{c}:{t}": [e, s] for (c, t), (e, s) in self._last.items()}
+
+    def restore(self, state: Dict[str, list]) -> None:
+        for key, (epoch, seq) in (state or {}).items():
+            crank, tag = (int(x) for x in key.split(":"))
+            self._last[(crank, tag)] = (int(epoch), int(seq))

@@ -1,0 +1,115 @@
+"""Server-side liveness: per-client leases over the HEARTBEAT channel.
+
+The pre-FT server's failure mode: every per-client service generator
+blocks in a probe loop, and the stop protocol counts STOPs from *all*
+clients — one dead worker therefore wedges the whole gang forever.  The
+lease registry replaces "wait forever" with a terminal-state machine per
+client:
+
+    ACTIVE --lease expiry--> EVICTED --INIT v3 (epoch+1)--> ACTIVE
+    ACTIVE --STOP----------> STOPPED
+
+Service loops pass ``registry.gone(crank)`` as their recv ``abort``
+predicate, so eviction unblocks them at the next probe poll; the stop
+condition becomes "every client STOPPED or EVICTED".  A lease is only
+armed for clients that *promised* heartbeats in their INIT v3 flags —
+arming it for a legacy (v1/v2) client would evict every pre-FT worker
+under a server with a TTL configured.
+
+Time is injected (``clock``) so eviction tests are instant and exact
+rather than sleep-based.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+ACTIVE = "active"
+EVICTED = "evicted"
+STOPPED = "stopped"
+
+
+class LeaseRegistry:
+    def __init__(
+        self,
+        client_ranks: "list[int]",
+        ttl_s: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self._state: Dict[int, str] = {c: ACTIVE for c in client_ranks}
+        self._expiry: Dict[int, Optional[float]] = {c: None for c in client_ranks}
+        self._epoch: Dict[int, int] = {c: 0 for c in client_ranks}
+        self._promised: set = set()
+        self.evictions = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def arm(self, crank: int, epoch: int, heartbeats: bool = False) -> None:
+        """Record the client's announced incarnation and heartbeat
+        promise.  The expiry clock starts at the *first renew*, not
+        here: between INIT and the first beat sits the seeding phase —
+        a large-shard seed can outlast any reasonable TTL, and evicting
+        the seeder mid-push wedges startup.  A client that promised
+        beats and then beats once is on the clock; one that never beats
+        never expires (its death is the supervisor's to notice)."""
+        self._epoch[crank] = epoch
+        if heartbeats:
+            self._promised.add(crank)
+        else:
+            self._promised.discard(crank)
+        self._expiry[crank] = None
+
+    def renew(self, crank: int, epoch: Optional[int] = None) -> None:
+        """A heartbeat (or any inbound op) from the client's *current*
+        incarnation pushes its expiry out — arming the lease on the
+        first one.  Beats from a stale epoch are ignored: a dead
+        incarnation's queued beacons must not keep its successor's
+        lease alive before the successor announces."""
+        if epoch is not None and epoch != self._epoch.get(crank):
+            return
+        if self.ttl_s > 0 and crank in self._promised:
+            self._expiry[crank] = self._clock() + self.ttl_s
+
+    def expired(self) -> List[int]:
+        """ACTIVE clients whose armed lease has lapsed (reaper input)."""
+        now = self._clock()
+        return [
+            c for c, exp in self._expiry.items()
+            if exp is not None and now > exp and self._state[c] == ACTIVE
+        ]
+
+    def evict(self, crank: int) -> None:
+        if self._state.get(crank) == ACTIVE:
+            self._state[crank] = EVICTED
+            self._expiry[crank] = None
+            self.evictions += 1
+
+    def stop(self, crank: int) -> None:
+        self._state[crank] = STOPPED
+        self._expiry[crank] = None
+
+    def rejoin(self, crank: int, epoch: int) -> None:
+        """A new incarnation re-announced: back to ACTIVE under its new
+        epoch (the lease re-arms when the INIT flags promise beats)."""
+        self._state[crank] = ACTIVE
+        self._epoch[crank] = epoch
+        self._expiry[crank] = None
+
+    # -- queries -------------------------------------------------------------
+
+    def epoch(self, crank: int) -> int:
+        return self._epoch.get(crank, 0)
+
+    def state(self, crank: int) -> str:
+        return self._state.get(crank, ACTIVE)
+
+    def gone(self, crank: int) -> bool:
+        """Abort predicate for this client's service recv loops."""
+        return self._state.get(crank) != ACTIVE
+
+    def all_done(self) -> bool:
+        """Stop condition: nobody left ACTIVE."""
+        return all(s != ACTIVE for s in self._state.values())

@@ -1,0 +1,190 @@
+"""Gang supervisor — restart dead ranks instead of tearing the gang down.
+
+``launch_gang`` (train/gang.py) keeps mpirun's contract: one dead rank
+kills the job.  This supervisor keeps the *gang's* contract instead: a
+worker that dies is restarted as a new incarnation (epoch + 1) that
+re-announces via INIT v3 and resumes against the live servers; a server
+that dies is restarted from its latest stamped shard snapshot (resume
+path) and keeps serving the surviving clients' retried ops.  The rest of
+the gang never exits — client deadlines/retry and server leases cover
+the gap while the replacement comes up.
+
+Restart mechanics per rank:
+
+- the replacement runs with ``MPIT_FT_EPOCH=<restart #>`` and
+  ``MPIT_FT_REJOIN=1`` (picked up by ``FTConfig.from_env`` inside the
+  child), and a per-child config with the startup barrier off — its
+  gang-mates are long past the rendezvous — plus ``resume=True`` for
+  server ranks;
+- restarts are budgeted (``RestartPolicy.max_restarts``): a rank that
+  keeps dying is a bug, not churn, and the supervisor fails loudly with
+  its log tail rather than flapping forever.
+
+``chaos_kill_rank``/``chaos_kill_after_s`` are the process-level arm of
+the fault-injection harness (ft/faults.py is the message-level arm): the
+soak test SIGKILLs a live worker mid-run through the supervisor itself,
+so the kill lands at a reproducible point in the supervision loop.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from mpit_tpu.utils.logging import get_logger
+
+
+@dataclass(frozen=True)
+class RestartPolicy:
+    #: restarts allowed per rank before the supervisor gives up.
+    max_restarts: int = 2
+    #: pause before respawning (lets the transport notice the death and
+    #: the lease reaper run, so the replacement finds a clean slate).
+    restart_delay_s: float = 0.5
+
+
+def supervise_gang(
+    child_module: str,
+    cfg: Any,
+    timeout: float = 3600.0,
+    policy: Optional[RestartPolicy] = None,
+    env_overrides: Optional[Dict[int, Dict[str, str]]] = None,
+    server_ranks: Optional[list] = None,
+    chaos_kill_rank: Optional[int] = None,
+    chaos_kill_after_s: float = 0.0,
+) -> Dict[int, Dict[str, Any]]:
+    """Run a gang to completion, restarting dead ranks under ``policy``.
+
+    Same result contract as ``launch_gang``: rank -> result dict.  A
+    rank's *final* incarnation must exit 0 and write its result file.
+    """
+    from mpit_tpu.train.gang import spawn_rank
+    from mpit_tpu.utils.config import Config
+
+    policy = policy or RestartPolicy()
+    log = get_logger("supervisor", 0)
+    size = int(cfg.np)
+    server_ranks = list(server_ranks or [])
+    namespace = cfg.get("namespace") or f"mpit{os.getpid()}"
+    cfg = cfg.merged(namespace=namespace)
+    logdir = tempfile.mkdtemp(prefix=f"{namespace}_logs_")
+
+    procs: Dict[int, Any] = {}
+    logfiles: Dict[int, str] = {}
+    resultfiles: Dict[int, str] = {}
+    restarts = {r: 0 for r in range(size)}
+    done: Dict[int, int] = {}  # rank -> exit code 0
+    for rank in range(size):
+        procs[rank], logfiles[rank], resultfiles[rank] = spawn_rank(
+            child_module, cfg, rank, size, logdir,
+            extra_env=(env_overrides or {}).get(rank),
+        )
+    chaos_at = (
+        time.monotonic() + chaos_kill_after_s
+        if chaos_kill_rank is not None else None
+    )
+    chaos_done = False
+    deadline = time.monotonic() + timeout
+
+    def _teardown(reason: str) -> None:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        raise RuntimeError(f"{reason} (logs: {logdir})")
+
+    def _restart_cfg(rank: int) -> "Config":
+        # The replacement must not re-run the startup rendezvous (its
+        # gang-mates are mid-run) and a server must reload its shard.
+        merged = cfg.merged(gang_barrier=False)
+        if rank in server_ranks:
+            if not str(cfg.get("server_ckpt_dir", "") or ""):
+                _teardown(
+                    f"server rank {rank} died but server_ckpt_dir is unset "
+                    "— no snapshot to restart from"
+                )
+            merged = merged.merged(resume=True)
+        return merged
+
+    while len(done) < size:
+        if time.monotonic() > deadline:
+            _teardown(f"supervised gang timed out after {timeout:.0f}s")
+        if chaos_at is not None and not chaos_done and time.monotonic() >= chaos_at:
+            victim = procs[chaos_kill_rank]
+            if victim.poll() is not None:
+                # A chaos kill that cannot land is a mis-tuned soak, and
+                # letting it pass silently would fake the coverage.
+                _teardown(
+                    f"chaos kill scheduled for rank {chaos_kill_rank} but "
+                    "it already exited — lower chaos_kill_after_s or "
+                    "lengthen the run"
+                )
+            log.warning("chaos: SIGKILL rank %d (pid %d)",
+                        chaos_kill_rank, victim.pid)
+            os.kill(victim.pid, signal.SIGKILL)
+            chaos_done = True
+        for rank, proc in procs.items():
+            if rank in done:
+                continue
+            code = proc.poll()
+            if code is None:
+                continue
+            if code == 0:
+                done[rank] = 0
+                continue
+            if restarts[rank] >= policy.max_restarts:
+                tail = ""
+                try:
+                    with open(logfiles[rank]) as fh:
+                        tail = "".join(fh.readlines()[-20:])
+                except OSError:
+                    pass
+                _teardown(
+                    f"rank {rank} exited {code} and exhausted its "
+                    f"{policy.max_restarts} restart(s)\n--- rank {rank} "
+                    f"log tail ---\n{tail}"
+                )
+            restarts[rank] += 1
+            log.warning(
+                "rank %d died (exit %s); restarting as epoch %d "
+                "(%d/%d restarts)",
+                rank, code, restarts[rank], restarts[rank],
+                policy.max_restarts,
+            )
+            time.sleep(policy.restart_delay_s)
+            extra = dict((env_overrides or {}).get(rank, {}))
+            extra["MPIT_FT_EPOCH"] = str(restarts[rank])
+            extra["MPIT_FT_REJOIN"] = "1"
+            procs[rank], logfiles[rank], resultfiles[rank] = spawn_rank(
+                child_module, _restart_cfg(rank), rank, size, logdir,
+                extra_env=extra,
+            )
+        time.sleep(0.1)
+
+    import json
+
+    results: Dict[int, Dict[str, Any]] = {}
+    for rank in range(size):
+        with open(logfiles[rank]) as fh:
+            for line in fh:
+                print(line.rstrip("\n"))
+        if os.path.exists(resultfiles[rank]):
+            with open(resultfiles[rank]) as fh:
+                results[rank] = json.load(fh)
+    missing = [r for r in range(size) if r not in results]
+    if missing:
+        raise RuntimeError(
+            f"ranks {missing} exited 0 but reported no result (logs: {logdir})"
+        )
+    import shutil
+
+    shutil.rmtree(logdir, ignore_errors=True)  # only useful on failure
+    return results

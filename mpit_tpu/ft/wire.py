@@ -1,0 +1,68 @@
+"""FT wire framing — the [epoch, seq] header and the INIT v3 announce.
+
+Every fault-tolerant retransmission question reduces to "has this exact
+op already been applied?", and the answer needs an identity on the wire.
+The identity is ``(client rank, epoch, seq)``:
+
+- **epoch** — the client's incarnation number.  A restarted worker
+  re-announces with ``epoch + 1``; anything still in flight from the
+  dead incarnation is recognizably stale.
+- **seq** — a per-(server, tag) counter on the client.  A retried op
+  resends the *same* seq, so the server can apply-at-most-once and
+  re-ack, and the client can match acks/replies to the attempt it is
+  actually waiting on (a stale duplicate ack must never satisfy a newer
+  op's wait — that would turn one dropped message into a lost update).
+
+Framed messages prepend ``HDR_BYTES`` of int64 ``[epoch, seq]`` to the
+codec frame; acks and read requests are exactly the 16-byte header.  The
+header travels *inside* the message (one transport send), so a fault
+injected at message granularity drops or duplicates the header and its
+payload atomically — there is no torn header/payload state to recover.
+
+Framing is negotiated per client<->server pair in INIT v3 (40 bytes:
+``[offset, size, codec_id, epoch, flags]``) and costs one staging copy
+per identity-codec frame, which is why it is opt-in (``FLAG_FRAMED``):
+heartbeat-only deployments keep the zero-copy legacy frames.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+#: int64 [epoch, seq]
+HDR_BYTES = 16
+
+#: INIT v3 flags bit0: GRAD/PARAM/PARAM_PUSH frames (and their acks /
+#: read requests) carry the [epoch, seq] header for this pair.
+FLAG_FRAMED = 1
+
+#: INIT v3 flags bit1: this client will send HEARTBEAT beacons — the
+#: server may arm a lease for it.  Kept separate from FLAG_FRAMED so a
+#: server with a TTL configured never evicts a client that never
+#: promised to beat (legacy ranks, framed-but-heartbeatless tests).
+FLAG_HEARTBEAT = 2
+
+
+def pack_header(buf: np.ndarray, epoch: int, seq: int) -> None:
+    """Write the [epoch, seq] header into the first HDR_BYTES of a uint8
+    staging buffer."""
+    buf[:HDR_BYTES].view(np.int64)[:] = (epoch, seq)
+
+
+def unpack_header(buf: np.ndarray) -> Tuple[int, int]:
+    """(epoch, seq) from the first HDR_BYTES of a uint8 buffer."""
+    hdr = buf[:HDR_BYTES].view(np.int64)
+    return int(hdr[0]), int(hdr[1])
+
+
+def header_frame(epoch: int, seq: int) -> np.ndarray:
+    """A fresh 16-byte header-only message (acks, PARAM_REQ, HEARTBEAT)."""
+    return np.asarray([epoch, seq], dtype=np.int64)
+
+
+def init_v3(offset: int, size: int, codec_id: int, epoch: int,
+            flags: int) -> np.ndarray:
+    """The 40-byte INIT v3 announcement payload."""
+    return np.asarray([offset, size, codec_id, epoch, flags], dtype=np.int64)

@@ -24,8 +24,21 @@ from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6: public API (check_vma kwarg)
+    from jax import shard_map
+except ImportError:  # older jax: experimental home, check_rep kwarg.
+    # Same shape as the pallas TPUCompilerParams/CompilerParams rename
+    # shim (ops/flash_attention.py): adapt the one renamed kwarg instead
+    # of pinning a jax version.  Siblings (moe, fused, ring_attention,
+    # tensor_parallel, pipeline) import shard_map from here.
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              **kwargs)
 
 
 def ps_pull(mesh: Mesh, axis: str = "shard") -> Callable[[jnp.ndarray], jnp.ndarray]:

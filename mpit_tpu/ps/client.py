@@ -22,18 +22,60 @@ of being lost, so DOWNPOUR/EASGD converge as if uncompressed (the shells
 in mpit_tpu.optim need no changes — they keep writing fp32 into
 ``grad``; encode happens here at ship time).  ``codec='none'`` keeps
 today's zero-copy slice sends byte-for-byte.
+
+Fault tolerance (mpit_tpu.ft): an :class:`FTConfig` adds, each
+independently opt-in,
+
+- **heartbeats** — 16-byte HEARTBEAT beacons to every server, emitted
+  opportunistically from ``ping``/``wait`` (the trainer's comm-overlap
+  cadence) so liveness costs no dedicated thread;
+- **op deadlines + retry** — every op encodes its frame *once* into a
+  staged buffer with an int64 ``[epoch, seq]`` header (ft/wire.py) and
+  resends those exact bytes on timeout under capped backoff.  Resending
+  the staged frame — never re-encoding — is what keeps the int8
+  error-feedback residual exact across retries: the residual was folded
+  at the single encode, so a retry cannot double-count it.  Acks and
+  PARAM replies echo the seq; stale echoes from earlier attempts are
+  consumed and discarded, never mistaken for the awaited one.  An op
+  that exhausts its attempts raises :class:`RetryExhausted` — loud
+  failure, never a hang.
+
+The header framing costs one staging copy per identity-codec frame, so
+it is only active when deadlines are (``FTConfig.framed``); a default
+FTConfig keeps the pre-FT zero-copy wire byte-for-byte.
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Deque, Dict, Generator, List, Optional, Tuple
 
 import numpy as np
 
-from mpit_tpu.aio import LiveFlag, Scheduler, aio_recv, aio_send
+from mpit_tpu.aio import (
+    DeadlineExceeded,
+    LiveFlag,
+    Scheduler,
+    aio_recv,
+    aio_send,
+    aio_sleep,
+    deadline_at,
+)
 from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
+from mpit_tpu.ft import (
+    FLAG_FRAMED,
+    FLAG_HEARTBEAT,
+    HDR_BYTES,
+    FTConfig,
+    RetryExhausted,
+    RetryPolicy,
+    header_frame,
+    init_v3,
+    pack_header,
+    unpack_header,
+)
 from mpit_tpu.ps import tags
 from mpit_tpu.ps.sharding import Shard, shard_layout
 from mpit_tpu.utils.logging import get_logger
@@ -48,6 +90,7 @@ class ParamClient:
         scheduler: Optional[Scheduler] = None,
         seed_servers: bool = False,
         codec: Optional[str] = None,
+        ft: Optional[FTConfig] = None,
     ):
         self.rank = rank
         self.sranks = list(server_ranks)
@@ -55,6 +98,8 @@ class ParamClient:
         self.sched = scheduler or Scheduler()
         self.seed_servers = seed_servers  # this is the first client
         self.codec = codec_mod.get(codec)  # None/'' -> $MPIT_PS_CODEC
+        self.ft = ft if ft is not None else FTConfig.from_env()
+        self._retry = RetryPolicy(self.ft, key=rank)
         self.live = LiveFlag()
         self.log = get_logger("pclient", rank)
         self.param: Optional[np.ndarray] = None
@@ -62,10 +107,19 @@ class ParamClient:
         self.shards: List[Shard] = []
         self._started = False
         # Per-server codec state: encode/decode staging sized to the wire
-        # format, plus the int8 error-feedback residual (grad path only).
+        # format (plus the FT header when framed), plus the int8
+        # error-feedback residual (grad path only).
+        self._hdr = HDR_BYTES if self.ft.framed else 0
         self._grad_wire: Dict[int, np.ndarray] = {}
         self._param_wire: Dict[int, np.ndarray] = {}
         self._residual: Dict[int, np.ndarray] = {}
+        self._ack_buf: Dict[int, np.ndarray] = {}
+        #: per-(server, tag) op sequence numbers (FT framing identity)
+        self._seq: Dict[Tuple[int, int], int] = {}
+        self._hb_last = 0.0
+        self._hb_seq = 0
+        self.retries = 0  # resends performed (observability/test hook)
+        self.heartbeats_sent = 0
         # Per-server FIFO op chains: ops addressed to the same server run in
         # issue order (a send_grad's ack completes before a later param
         # request is sent), while different servers stay fully concurrent.
@@ -81,28 +135,50 @@ class ParamClient:
     def start(self, param: np.ndarray, grad: np.ndarray) -> None:
         """Announce shard layout + codec to every server; the first client
         seeds the servers' shards from ``param`` (reference
-        pclient.lua:111-129).  INIT v2: int64 [offset, size, codec_id]."""
+        pclient.lua:111-129).  INIT v2: int64 [offset, size, codec_id];
+        with any FT feature active, INIT v3 adds [epoch, flags]."""
         self._register(param, grad)
         self.shards = shard_layout(len(param), len(self.sranks))
+        flags = (FLAG_FRAMED if self.ft.framed else 0) | (
+            FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0
+        )
         for srank, shard in zip(self.sranks, self.shards):
             if not self.codec.identity:
-                nbytes = self.codec.wire_nbytes(shard.size)
+                nbytes = self._hdr + self.codec.wire_nbytes(shard.size)
                 self._grad_wire[srank] = np.zeros(nbytes, np.uint8)
                 self._param_wire[srank] = np.zeros(nbytes, np.uint8)
                 if self.codec.uses_residual:
                     self._residual[srank] = np.zeros(shard.size, np.float32)
-            cinfo = np.asarray(
-                [shard.offset, shard.size, self.codec.wire_id], dtype=np.int64
-            )
+            elif self._hdr:
+                # Identity codec under FT framing: raw dtype bytes behind
+                # the header (the one staging copy framing costs).
+                nbytes = self._hdr + shard.size * param.dtype.itemsize
+                self._grad_wire[srank] = np.zeros(nbytes, np.uint8)
+                self._param_wire[srank] = np.zeros(nbytes, np.uint8)
+            if self._hdr:
+                self._ack_buf[srank] = np.zeros(2, np.int64)
+            if self.ft.active:
+                cinfo = init_v3(shard.offset, shard.size,
+                                self.codec.wire_id, self.ft.epoch, flags)
+            else:
+                cinfo = np.asarray(
+                    [shard.offset, shard.size, self.codec.wire_id],
+                    dtype=np.int64,
+                )
             self.sched.spawn(
-                aio_send(self.transport, cinfo, srank, tags.INIT, live=self.live),
+                aio_send(self.transport, cinfo, srank, tags.INIT,
+                         live=self.live, deadline=self._op_deadline()),
                 name=f"send_init:{srank}",
             )
         self.wait()
+        # Beat from the moment the servers know this client's epoch —
+        # seeding a large shard below can outlast any reasonable lease
+        # TTL, and the wait() loop is what pumps the beacons out.
+        self._started = True
+        self._hb_last = 0.0
         if self.seed_servers:
             self.async_send_param()
             self.wait()
-        self._started = True
 
     def _register(self, param: np.ndarray, grad: np.ndarray) -> None:
         # Dtype-agnostic: shards are element ranges; transports move bytes.
@@ -127,52 +203,212 @@ class ParamClient:
             raise ValueError("reset buffers must keep the registered length")
         self._register(param, grad)
 
+    # -- FT plumbing ---------------------------------------------------------
+
+    def _op_deadline(self) -> Optional[float]:
+        """Absolute deadline for one attempt (None when deadlines off)."""
+        return deadline_at(self.ft.deadline_s)
+
+    def _next_seq(self, srank: int, tag: int) -> int:
+        seq = self._seq.get((srank, tag), 0) + 1
+        self._seq[(srank, tag)] = seq
+        return seq
+
+    def _op_with_retry(self, srank: int, payload: np.ndarray, tag: int,
+                       ack_tag: int, seq: int, what: str):
+        """Send the staged frame, await its seq-matched ack; resend the
+        same bytes on deadline under the backoff policy.  Exhaustion
+        raises :class:`RetryExhausted` — the never-hang guarantee."""
+        last: Optional[BaseException] = None
+        for attempt in range(self._retry.attempts):
+            if attempt:
+                self.retries += 1
+                self.log.debug("%s: retry %d after %r", what, attempt, last)
+                if not (yield from aio_sleep(
+                        self._retry.backoff_s(attempt), live=self.live)):
+                    return None
+            deadline = self._op_deadline()
+            try:
+                yield from aio_send(self.transport, payload, srank, tag,
+                                    live=self.live, deadline=deadline)
+                got = yield from self._await_ack(srank, ack_tag, seq, deadline)
+                if got is not None or not self.live.io:
+                    return got
+            except DeadlineExceeded as exc:
+                last = exc
+        raise RetryExhausted(what, self._retry.attempts, last)
+
+    def _await_ack(self, srank: int, ack_tag: int, seq: int,
+                   deadline: Optional[float]):
+        """Receive acks until the one echoing ``seq`` for the current
+        epoch arrives.  Stale echoes (an earlier attempt's duplicate, a
+        previous incarnation's leftovers) are consumed and dropped — on
+        the attempt's unchanged deadline, so a trickle of stale acks
+        cannot extend it."""
+        buf = self._ack_buf[srank]
+        while True:
+            got = yield from aio_recv(self.transport, srank, ack_tag,
+                                      live=self.live, out=buf,
+                                      deadline=deadline)
+            if got is None:
+                return None
+            epoch, aseq = int(buf[0]), int(buf[1])
+            if epoch == self.ft.epoch and aseq == seq:
+                return got
+            if epoch > self.ft.epoch or (epoch == self.ft.epoch and aseq > seq):
+                raise RuntimeError(
+                    f"ack from server {srank} is ahead of the op stream: "
+                    f"got (epoch={epoch}, seq={aseq}), awaiting "
+                    f"(epoch={self.ft.epoch}, seq={seq})"
+                )
+
+    def _maybe_heartbeat(self) -> None:
+        """Emit a HEARTBEAT to every server when the interval elapsed.
+        Piggybacks on ping()/wait() — the cadence the trainers already
+        drive for comm overlap — so liveness needs no thread.  Sends are
+        fire-and-forget with a bounded deadline: a dead server must not
+        accumulate unbounded heartbeat tasks in the queue."""
+        hb = self.ft.heartbeat_s
+        if hb <= 0 or not self._started or not self.live.io:
+            return
+        now = time.monotonic()
+        if now - self._hb_last < hb:
+            return
+        self._hb_last = now
+        self._hb_seq += 1
+        payload = header_frame(self.ft.epoch, self._hb_seq)
+        self.heartbeats_sent += 1
+        for srank in self.sranks:
+            self.sched.spawn(
+                self._hb_send(payload, srank), name=f"heartbeat:{srank}"
+            )
+
+    def _hb_send(self, payload: np.ndarray, srank: int):
+        try:
+            yield from aio_send(
+                self.transport, payload, srank, tags.HEARTBEAT,
+                live=self.live, deadline=deadline_at(4 * self.ft.heartbeat_s),
+            )
+        except DeadlineExceeded:
+            pass  # liveness is best-effort; the next beat tries again
+
     # -- per-server transfer generators -------------------------------------
 
     def _send_grad(self, srank: int, shard: Shard):
         """Ship the grad slice, await the applied ack
         (reference pclient.lua:48-58).  Non-identity codecs encode into
         the per-server staging frame at ship time; the int8 residual is
-        folded in and refreshed by the same pass."""
+        folded in and refreshed by the same pass.  Framed mode stamps
+        [epoch, seq] and retries the staged bytes on deadline."""
         view = self.grad[shard.offset : shard.end]
-        payload = self._encode(view, self._grad_wire.get(srank),
-                               residual=self._residual.get(srank))
-        yield from aio_send(self.transport, payload, srank, tags.GRAD, live=self.live)
-        yield from aio_recv(self.transport, srank, tags.GRAD_ACK, live=self.live)
+        wire = self._grad_wire.get(srank)
+        payload = self._encode(view, wire, residual=self._residual.get(srank))
+        if not self.ft.framed:
+            yield from aio_send(self.transport, payload, srank, tags.GRAD,
+                                live=self.live, deadline=self._op_deadline())
+            yield from aio_recv(self.transport, srank, tags.GRAD_ACK,
+                                live=self.live, deadline=self._op_deadline())
+            return
+        seq = self._next_seq(srank, tags.GRAD)
+        pack_header(payload, self.ft.epoch, seq)
+        yield from self._op_with_retry(
+            srank, payload, tags.GRAD, tags.GRAD_ACK, seq,
+            f"GRAD to server {srank}",
+        )
 
     def _recv_param(self, srank: int, shard: Shard):
         """Request-to-read header, then receive into the param slice
         (reference pclient.lua:72-82) — via the wire staging frame when
-        the codec is not identity."""
-        yield from aio_send(
-            self.transport, tags.EMPTY, srank, tags.PARAM_REQ, live=self.live
-        )
+        the codec is not identity.  Framed mode seq-tags the request and
+        discards snapshot frames that echo an earlier request."""
         out = self.param[shard.offset : shard.end]
         wire = self._param_wire.get(srank)
-        got = yield from aio_recv(
-            self.transport, srank, tags.PARAM, live=self.live,
-            out=out if wire is None else wire,
-        )
-        if got is not None and wire is not None:
-            self.codec.decode_into(wire, out)
+        if not self.ft.framed:
+            yield from aio_send(self.transport, tags.EMPTY, srank,
+                                tags.PARAM_REQ, live=self.live,
+                                deadline=self._op_deadline())
+            got = yield from aio_recv(
+                self.transport, srank, tags.PARAM, live=self.live,
+                out=out if wire is None else wire,
+                deadline=self._op_deadline(),
+            )
+            if got is not None and wire is not None:
+                self.codec.decode_into(wire, out)
+            return
+        seq = self._next_seq(srank, tags.PARAM_REQ)
+        req = header_frame(self.ft.epoch, seq)
+        last: Optional[BaseException] = None
+        for attempt in range(self._retry.attempts):
+            if attempt:
+                self.retries += 1
+                if not (yield from aio_sleep(
+                        self._retry.backoff_s(attempt), live=self.live)):
+                    return
+            deadline = self._op_deadline()
+            try:
+                yield from aio_send(self.transport, req, srank,
+                                    tags.PARAM_REQ, live=self.live,
+                                    deadline=deadline)
+                while True:
+                    got = yield from aio_recv(
+                        self.transport, srank, tags.PARAM, live=self.live,
+                        out=wire, deadline=deadline,
+                    )
+                    if got is None:
+                        return
+                    epoch, aseq = unpack_header(wire)
+                    if epoch == self.ft.epoch and aseq == seq:
+                        self._decode_framed(wire, out)
+                        return
+                    # stale snapshot (earlier request's duplicate): drop
+            except DeadlineExceeded as exc:
+                last = exc
+        raise RetryExhausted(
+            f"PARAM read from server {srank}", self._retry.attempts, last)
 
     def _send_param(self, srank: int, shard: Shard):
         """Whole-shard write, await ack (reference pclient.lua:60-70).
         No residual: parameter pushes (seeding / single-worker mirror)
         are one-shot state transfers, not an accumulating signal."""
         view = self.param[shard.offset : shard.end]
-        payload = self._encode(view, self._param_wire.get(srank))
-        yield from aio_send(self.transport, payload, srank, tags.PARAM_PUSH, live=self.live)
-        yield from aio_recv(self.transport, srank, tags.PARAM_PUSH_ACK, live=self.live)
+        wire = self._param_wire.get(srank)
+        payload = self._encode(view, wire)
+        if not self.ft.framed:
+            yield from aio_send(self.transport, payload, srank,
+                                tags.PARAM_PUSH, live=self.live,
+                                deadline=self._op_deadline())
+            yield from aio_recv(self.transport, srank, tags.PARAM_PUSH_ACK,
+                                live=self.live, deadline=self._op_deadline())
+            return
+        seq = self._next_seq(srank, tags.PARAM_PUSH)
+        pack_header(payload, self.ft.epoch, seq)
+        yield from self._op_with_retry(
+            srank, payload, tags.PARAM_PUSH, tags.PARAM_PUSH_ACK, seq,
+            f"PARAM_PUSH to server {srank}",
+        )
 
     def _encode(self, view: np.ndarray, wire: Optional[np.ndarray],
                 residual: Optional[np.ndarray] = None) -> np.ndarray:
         """The slice itself for the identity codec (zero-copy send);
-        otherwise the encoded frame in the per-server staging buffer."""
+        otherwise the encoded frame in the per-server staging buffer —
+        behind the [epoch, seq] header slot when FT framing is on.  The
+        encode (and its residual fold) happens exactly once per op;
+        retries resend these bytes."""
         if wire is None:
             return view
-        self.codec.encode_into(view, wire, residual=residual)
+        body = wire[self._hdr :]
+        if self.codec.identity:
+            body[:] = view.view(np.uint8)
+        else:
+            self.codec.encode_into(view, body, residual=residual)
         return wire
+
+    def _decode_framed(self, wire: np.ndarray, out: np.ndarray) -> None:
+        body = wire[self._hdr :]
+        if self.codec.identity:
+            out.view(np.uint8)[:] = body
+        else:
+            self.codec.decode_into(body, out)
 
     def residual_norm(self) -> float:
         """L2 norm of the error-feedback residuals across shards — 0.0
@@ -225,10 +461,21 @@ class ParamClient:
     def ping(self, n: int = 1) -> None:
         """Single-step I/O progress to overlap with compute
         (reference pclient.lua:131-136)."""
+        self._maybe_heartbeat()
         for _ in range(n):
             self.sched.ping()
 
     def wait(self) -> None:
+        if self.ft.heartbeat_s > 0:
+            # Keep beating while blocked on slow servers: the wait loop is
+            # exactly where a stalled gang would otherwise go silent and
+            # get this client evicted.
+            while self.sched.queue:
+                self._maybe_heartbeat()
+                self.sched.ping_pass()
+            if self.sched.errors:
+                raise self.sched.errors.pop(0)
+            return
         self.sched.wait()
 
     # -- shutdown (reference pclient.lua:153-164) ---------------------------
@@ -239,7 +486,8 @@ class ParamClient:
         for srank in self.sranks:
             self._enqueue(
                 srank,
-                aio_send(self.transport, tags.EMPTY, srank, tags.STOP, live=self.live),
+                aio_send(self.transport, tags.EMPTY, srank, tags.STOP,
+                         live=self.live, deadline=self._op_deadline()),
                 "send_stop",
             )
         self.wait()

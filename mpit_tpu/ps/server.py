@@ -34,19 +34,52 @@ are served from a **version-counted encoded snapshot cache**: the
 version bumps on every apply/seed, and N clients pulling the same
 committed version cost one device->host copy plus one encode, not N
 (``snapshot_copies`` / ``snapshot_hits`` count the win).
+
+Fault tolerance (mpit_tpu.ft): the server's pre-FT failure mode was to
+block forever on a dead client — every per-client service loop recv'd
+unboundedly and the stop protocol counted STOPs from all clients.  Now:
+
+- a :class:`LeaseRegistry` tracks per-client liveness from HEARTBEAT
+  beacons (INIT v3 announces them); an expired lease **evicts** the
+  client: its service loops unblock via their ``abort`` predicate, its
+  staging is released, and the stop condition becomes "every client
+  STOPPED or EVICTED" — the gang survives the loss;
+- framed clients' GRAD / PARAM_PUSH frames carry [epoch, seq] headers,
+  admitted through a :class:`DedupTable` so a retried op is applied at
+  most once and its ack re-sent (the client's retry makes delivery
+  at-least-once; dedup makes the apply exactly-once);
+- when rejoin is enabled, a per-client INIT listener accepts a new
+  incarnation mid-run (epoch+1), tears down the old generation's
+  services, and respawns them against the new epoch;
+- checkpoints carry the dedup table and each client's negotiated state,
+  so a *restarted server* resumes serving retried ops without fresh
+  INITs (clients never learn the server died — their deadlines cover
+  the gap).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mpit_tpu.aio import LiveFlag, Scheduler, aio_recv, aio_send
+from mpit_tpu.aio import EXEC, LiveFlag, Scheduler, aio_recv, aio_send, aio_sleep
 from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
+from mpit_tpu.ft import (
+    DUP,
+    FLAG_FRAMED,
+    FLAG_HEARTBEAT,
+    HDR_BYTES,
+    STALE,
+    DedupTable,
+    FTConfig,
+    LeaseRegistry,
+    unpack_header,
+)
 from mpit_tpu.optim.rules import ShardRule, make as make_rule
 from mpit_tpu.ps import tags
 from mpit_tpu.utils.logging import get_logger
@@ -67,6 +100,7 @@ class ParamServer:
         device: str = "cpu",  # "cpu" (host role, reference-faithful) | "default"
         codec: Optional[str] = None,  # None: adopt each client's announcement;
         #                               a name pins it — mismatches fail loudly
+        ft: Optional[FTConfig] = None,
     ):
         self.rank = rank
         self.cranks = list(client_ranks)
@@ -85,7 +119,6 @@ class ParamServer:
         self.param: Optional[jnp.ndarray] = None  # device-resident shard
         self.rule_state = None
         self.grad_bufs: Dict[int, np.ndarray] = {}  # host recv staging, per client
-        self._stopped_clients = 0
         # Codec negotiation state (INIT v2).  codec=None adopts whatever
         # each client announces (per-pair negotiation — mixed-codec
         # gangs are legal); an explicit name validates every
@@ -96,9 +129,30 @@ class ParamServer:
         self._codec_pin = codec or None
         self._codecs: Dict[int, codec_mod.Codec] = {}
         self._grad_views: Dict[int, List[np.ndarray]] = {}
+        self._grad_data: Dict[int, np.ndarray] = {}  # identity typed view
         self._push_bufs: Dict[int, np.ndarray] = {}
         self._push_host: Dict[int, np.ndarray] = {}
         self._apply_cache: Dict[str, Callable] = {}
+        # FT state (mpit_tpu.ft): lease per client, dedup on
+        # (client, epoch, seq), per-client service generation (bumped on
+        # rejoin/eviction so stale loops abort), framed/heartbeat flags
+        # from INIT v3, and the reply staging the framed paths need.
+        self.ft = ft if ft is not None else FTConfig.from_env()
+        self.leases = LeaseRegistry(self.cranks, ttl_s=self.ft.lease_ttl_s)
+        self.dedup = DedupTable()
+        self._framed: Dict[int, bool] = {}
+        self._hb: Dict[int, bool] = {}
+        self._gen: Dict[int, int] = {c: 0 for c in self.cranks}
+        self._svc_live: Dict[int, int] = {c: 0 for c in self.cranks}
+        self._param_send: Dict[int, np.ndarray] = {}
+        self._ack_send: Dict[int, np.ndarray] = {}
+        self._req_buf: Dict[int, np.ndarray] = {}
+        self._hb_buf: Dict[int, np.ndarray] = {}
+        self._restored_clients: set = set()
+        self.dup_ops = 0  # framed ops admitted as duplicates (re-acked)
+        self.stale_drops = 0  # stale-epoch frames dropped without ack
+        self.heartbeats_seen = 0
+        self.rejoins = 0
         # Version-counted snapshot cache: _snap_version bumps on every
         # committed write (grad apply / seed / restore); _snap_host is
         # the one device->host copy for that version and _snap_wire the
@@ -146,23 +200,27 @@ class ParamServer:
             return contextlib.nullcontext()
         return jax.default_device(self._device)
 
-    # -- codec plumbing ------------------------------------------------------
+    # -- codec + FT negotiation ---------------------------------------------
 
     def _negotiate(self, crank: int, payload: bytes) -> "codec_mod.Codec":
-        """Parse the INIT announcement (v1 or v2) into (offset, size) on
-        self and the negotiated codec for this client.  Every failure
-        here is loud — a codec disagreement must never reach the frame
-        decoders, where it would corrupt parameters silently."""
+        """Parse the INIT announcement (v1/v2/v3) into (offset, size) on
+        self, the negotiated codec, and the client's FT posture (epoch +
+        framed/heartbeat flags).  Every failure here is loud — a codec
+        disagreement must never reach the frame decoders, where it would
+        corrupt parameters silently."""
         raw = np.frombuffer(payload, dtype=np.int64)
+        epoch, flags = 0, 0
         if raw.size == 2:  # legacy 16-byte v1 announcement
             offset, size, wire_id = int(raw[0]), int(raw[1]), 0
         elif raw.size == 3:
             offset, size, wire_id = (int(x) for x in raw)
+        elif raw.size == 5:  # INIT v3: [offset, size, codec_id, epoch, flags]
+            offset, size, wire_id, epoch, flags = (int(x) for x in raw)
         else:
             raise ValueError(
                 f"client {crank} INIT announcement is {len(payload)} bytes; "
-                "expected 16 (legacy [offset, size]) or 24 "
-                "([offset, size, codec_id])"
+                "expected 16 (legacy [offset, size]), 24 "
+                "([offset, size, codec_id]) or 40 (v3 + [epoch, flags])"
             )
         codec = codec_mod.by_wire_id(wire_id)
         if self._codec_pin is not None and codec.name != self._codec_pin:
@@ -189,7 +247,48 @@ class ParamServer:
                 f"client {crank} announced shard ({offset},{size}) but server "
                 f"{self.rank} already holds ({self.offset},{self.size})"
             )
+        self._framed[crank] = bool(flags & FLAG_FRAMED)
+        self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
+        self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
         return codec
+
+    def _hdr_for(self, crank: int) -> int:
+        return HDR_BYTES if self._framed.get(crank) else 0
+
+    def _alloc_client(self, crank: int, codec: "codec_mod.Codec") -> None:
+        """(Re)allocate every per-client staging buffer for the client's
+        negotiated codec + framing — initial INIT and rejoin both land
+        here, so a rejoining incarnation may change codec freely."""
+        hdr = self._hdr_for(crank)
+        self._codecs[crank] = codec
+        self._grad_views.pop(crank, None)
+        self._grad_data.pop(crank, None)
+        self._push_bufs.pop(crank, None)
+        self._push_host.pop(crank, None)
+        self._param_send.pop(crank, None)
+        if codec.identity:
+            buf = np.zeros(hdr + self.size * np.dtype(self.dtype).itemsize,
+                           np.uint8)
+            self.grad_bufs[crank] = buf
+            self._grad_data[crank] = buf[hdr:].view(self.dtype)
+        else:
+            buf = np.zeros(hdr + codec.wire_nbytes(self.size), np.uint8)
+            self.grad_bufs[crank] = buf
+            self._grad_views[crank] = codec.split_wire(buf[hdr:], self.size)
+        if hdr:
+            self._ack_send[crank] = np.zeros(2, np.int64)
+            self._req_buf[crank] = np.zeros(2, np.int64)
+        if self._hb.get(crank):
+            self._hb_buf[crank] = np.zeros(2, np.int64)
+
+    def _release_client(self, crank: int) -> None:
+        """Drop an evicted client's staging (its shard registration's
+        per-client footprint); the shard itself is shared state."""
+        for store in (self.grad_bufs, self._grad_views, self._grad_data,
+                      self._push_bufs, self._push_host, self._param_send,
+                      self._codecs, self._ack_send, self._req_buf,
+                      self._hb_buf):
+            store.pop(crank, None)
 
     def _apply_for(self, codec: "codec_mod.Codec") -> Callable:
         """The jitted shard update for one codec: frame decode fused with
@@ -212,14 +311,19 @@ class ParamServer:
 
     def _push_staging(self, crank: int) -> np.ndarray:
         """Lazily-allocated PARAM_PUSH recv staging for one client, sized
-        to its codec's wire format (cold path: seeding / single mode)."""
+        to its codec's wire format plus the FT header when framed (cold
+        path: seeding / single mode)."""
         buf = self._push_bufs.get(crank)
         if buf is None:
             codec = self._codecs[crank]
-            if codec.identity:
+            hdr = self._hdr_for(crank)
+            if codec.identity and not hdr:
                 buf = np.zeros((self.size,), dtype=self.dtype)
+            elif codec.identity:
+                buf = np.zeros(hdr + self.size * np.dtype(self.dtype).itemsize,
+                               np.uint8)
             else:
-                buf = np.zeros(codec.wire_nbytes(self.size), np.uint8)
+                buf = np.zeros(hdr + codec.wire_nbytes(self.size), np.uint8)
                 self._push_host[crank] = np.zeros((self.size,), np.float32)
             self._push_bufs[crank] = buf
         return buf
@@ -252,96 +356,217 @@ class ParamServer:
         self._snap_wire[codec.name] = (version, wire)
         return wire
 
+    # -- FT service plumbing -------------------------------------------------
+
+    def _svc_abort(self, crank: int, gen: int) -> Callable[[], bool]:
+        """Abort predicate for one service generation: fire when the
+        client left (evicted/stopped) or a newer incarnation's services
+        superseded this generation."""
+        return lambda: self.leases.gone(crank) or self._gen[crank] != gen
+
+    def _svc(self, crank: int, gen: int, fn: Callable, *args, **kw):
+        """Run one service generator while tracking per-client service
+        liveness, so a rejoin can wait for the old generation to clear
+        before respawning (two generations recv'ing one channel would
+        scramble the seq stream)."""
+        self._svc_live[crank] += 1
+        try:
+            yield from fn(crank, *args, gen=gen, **kw)
+        finally:
+            self._svc_live[crank] -= 1
+
+    def _send_ack(self, crank: int, tag: int, epoch: int, seq: int, gen: int):
+        buf = self._ack_send[crank]
+        buf[0], buf[1] = epoch, seq
+        yield from aio_send(self.transport, buf, crank, tag, live=self.live,
+                            abort=self._svc_abort(crank, gen))
+
     # -- service generators (reference pserver.lua coroutines) --------------
 
-    def _recv_init(self, crank: int):
-        """Receive [offset, size(, codec_id)]; negotiate the codec and
-        allocate shard + staging state (reference :33-57)."""
-        payload = yield from aio_recv(self.transport, crank, tags.INIT, live=self.live)
+    def _recv_init(self, crank: int, gen: int = 0):
+        """Receive [offset, size(, codec_id(, epoch, flags))]; negotiate
+        codec + FT posture and allocate shard + staging state
+        (reference :33-57)."""
+        payload = yield from aio_recv(self.transport, crank, tags.INIT,
+                                      live=self.live)
         if payload is None:
             return
         codec = self._negotiate(crank, payload)
-        self._codecs[crank] = codec
-        if codec.identity:
-            self.grad_bufs[crank] = np.zeros((self.size,), dtype=self.dtype)
-        else:
-            buf = np.zeros(codec.wire_nbytes(self.size), np.uint8)
-            self.grad_bufs[crank] = buf
-            self._grad_views[crank] = codec.split_wire(buf, self.size)
+        self._alloc_client(crank, codec)
+
+    def _init_listener(self, crank: int):
+        """Perpetual rejoin listener (phase 3, FT only): a restarted
+        incarnation re-announces on INIT; accept it, supersede the old
+        generation's services, and respawn against the new epoch.  The
+        INIT v3 handshake is the whole rejoin protocol — the client then
+        simply pulls current params and resumes."""
+        while self.live.on:
+            payload = yield from aio_recv(self.transport, crank, tags.INIT,
+                                          live=self.live)
+            if payload is None:
+                return
+            codec = self._negotiate(crank, payload)
+            self._gen[crank] += 1
+            gen = self._gen[crank]
+            self.leases.rejoin(crank, self.leases.epoch(crank))
+            self.leases.arm(crank, self.leases.epoch(crank),
+                            heartbeats=self._hb.get(crank, False))
+            self._alloc_client(crank, codec)
+            self.rejoins += 1
+            # Two generations must never recv one channel concurrently —
+            # wait for the superseded loops to abort out.
+            while self._svc_live[crank] > 0:
+                yield EXEC
+            self._spawn_services(crank)
+            self.log.info(
+                "client %d rejoined (epoch %d, gen %d)",
+                crank, self.leases.epoch(crank), gen,
+            )
 
     def _recv_param(self, crank: int, once: bool = True,
-                    warn_unexpected: bool = False):
+                    warn_unexpected: bool = False, gen: int = 0):
         """Whole-shard write from a client: one-shot seeding from the first
         client (reference :92-102) or perpetual in single mode (the
-        BiCNN recvparam_always service, BiCNN/pserver.lua:220-232)."""
+        BiCNN recvparam_always service, BiCNN/pserver.lua:220-232).
+        Framed pushes are dedup-admitted: a retried seed is applied once
+        and re-acked."""
         codec = self._codecs.get(crank)
         if codec is None:  # init never completed (stopped before announce)
             return
+        framed = self._framed.get(crank, False)
+        hdr = self._hdr_for(crank)
         staging = self._push_staging(crank)
         while self.live.on:
             got = yield from aio_recv(
                 self.transport, crank, tags.PARAM_PUSH,
-                live=self.live, out=staging,
+                live=self.live, out=staging, abort=self._svc_abort(crank, gen),
             )
             if got is None:
                 return
+            epoch = seq = 0
+            if framed:
+                epoch, seq = unpack_header(staging)
+                self.leases.renew(crank, epoch)
+                verdict = self.dedup.admit(crank, tags.PARAM_PUSH, epoch, seq)
+                if verdict == STALE:
+                    self.stale_drops += 1
+                    continue
+                if verdict == DUP:
+                    self.dup_ops += 1
+                    yield from self._send_ack(
+                        crank, tags.PARAM_PUSH_ACK, epoch, seq, gen)
+                    continue
             if warn_unexpected:
                 self.log.warning(
                     "client %d seeded a RESTORED server: checkpointed "
                     "params overwritten (optimizer state kept) — start "
                     "resume clients with seed_servers=False", crank,
                 )
-            if codec.identity:
+            if codec.identity and not hdr:
                 host = staging
+            elif codec.identity:
+                host = staging[hdr:].view(self.dtype)
             else:  # cold path: host decode, then one h2d
                 host = self._push_host[crank]
-                codec.decode_into(staging, host)
+                codec.decode_into(staging[hdr:], host)
             with self._dev_ctx():
                 self.param = jnp.asarray(host)
             self._committed()
-            yield from aio_send(
-                self.transport, tags.EMPTY, crank, tags.PARAM_PUSH_ACK, live=self.live
-            )
+            if framed:
+                yield from self._send_ack(
+                    crank, tags.PARAM_PUSH_ACK, epoch, seq, gen)
+            else:
+                yield from aio_send(
+                    self.transport, tags.EMPTY, crank, tags.PARAM_PUSH_ACK,
+                    live=self.live, abort=self._svc_abort(crank, gen),
+                )
             if once:
                 return
 
-    def _send_param(self, crank: int):
-        """Loop: await 0-byte read request, send the current version's
-        encoded snapshot (reference :59-72)."""
+    def _send_param(self, crank: int, gen: int = 0):
+        """Loop: await the read request, send the current version's
+        encoded snapshot (reference :59-72).  Framed requests carry
+        [epoch, seq]; the reply echoes it so the client can discard
+        snapshots answering an earlier (retried) request.  Reads are
+        idempotent — duplicates are served, never dedup'd."""
         codec = self._codecs.get(crank)
         if codec is None:  # init never completed (stopped before announce)
             return
+        framed = self._framed.get(crank, False)
         while self.live.on:
+            req = self._req_buf.get(crank) if framed else None
             got = yield from aio_recv(
-                self.transport, crank, tags.PARAM_REQ, live=self.live
+                self.transport, crank, tags.PARAM_REQ, live=self.live,
+                out=req, abort=self._svc_abort(crank, gen),
             )
             if got is None:
                 return
-            if self.live.io:
+            if not self.live.io:
+                continue
+            if not framed:
                 snapshot = self._snapshot_wire(codec)
                 yield from aio_send(
-                    self.transport, snapshot, crank, tags.PARAM, live=self.live
+                    self.transport, snapshot, crank, tags.PARAM,
+                    live=self.live, abort=self._svc_abort(crank, gen),
                 )
                 self.params_served += 1
+                continue
+            epoch, seq = int(req[0]), int(req[1])
+            if epoch < self.leases.epoch(crank):
+                self.stale_drops += 1  # dead incarnation's request
+                continue
+            self.leases.renew(crank, epoch)
+            wire = self._snapshot_wire(codec)
+            wire_u8 = wire.view(np.uint8) if wire.dtype != np.uint8 else wire
+            reply = self._param_send.get(crank)
+            if reply is None or len(reply) != HDR_BYTES + len(wire_u8):
+                reply = np.zeros(HDR_BYTES + len(wire_u8), np.uint8)
+                self._param_send[crank] = reply
+            reply[:HDR_BYTES].view(np.int64)[:] = (epoch, seq)
+            reply[HDR_BYTES:] = wire_u8
+            yield from aio_send(
+                self.transport, reply, crank, tags.PARAM, live=self.live,
+                abort=self._svc_abort(crank, gen),
+            )
+            self.params_served += 1
 
-    def _recv_grad(self, crank: int):
+    def _recv_grad(self, crank: int, gen: int = 0):
         """Loop: receive gradient frame, decode+apply the shard rule in
-        one jitted call, ack (reference :75-90 — the server hot loop)."""
+        one jitted call, ack (reference :75-90 — the server hot loop).
+        Framed frames are dedup-admitted on (epoch, seq): duplicates are
+        re-acked without a second apply — with the client's encode-once
+        staging this is what keeps error feedback exact under retries."""
         codec = self._codecs.get(crank)
         if codec is None:  # init never completed (stopped before announce)
             return
+        framed = self._framed.get(crank, False)
         gbuf = self.grad_bufs[crank]
         parts = self._grad_views.get(crank)
+        data = self._grad_data.get(crank)
         apply_fn = self._apply_for(codec)
         while self.live.on:
             got = yield from aio_recv(
-                self.transport, crank, tags.GRAD, live=self.live, out=gbuf
+                self.transport, crank, tags.GRAD, live=self.live, out=gbuf,
+                abort=self._svc_abort(crank, gen),
             )
             if got is None:
                 return
+            epoch = seq = 0
+            if framed:
+                epoch, seq = unpack_header(gbuf)
+                self.leases.renew(crank, epoch)
+                verdict = self.dedup.admit(crank, tags.GRAD, epoch, seq)
+                if verdict == STALE:
+                    self.stale_drops += 1
+                    continue
+                if verdict == DUP:
+                    self.dup_ops += 1
+                    yield from self._send_ack(crank, tags.GRAD_ACK,
+                                              epoch, seq, gen)
+                    continue
             with self._dev_ctx():
                 if parts is None:
-                    grad_in: Any = jnp.asarray(gbuf)
+                    grad_in: Any = jnp.asarray(data if data is not None else gbuf)
                 else:
                     grad_in = [jnp.asarray(v) for v in parts]
                 self.param, self.rule_state = apply_fn(
@@ -349,45 +574,124 @@ class ParamServer:
                 )
             self.grads_applied += 1
             self._committed()
-            if self.live.on:
+            if not self.live.on:
+                continue
+            if framed:
+                yield from self._send_ack(crank, tags.GRAD_ACK, epoch, seq, gen)
+            else:
                 yield from aio_send(
-                    self.transport, tags.EMPTY, crank, tags.GRAD_ACK, live=self.live
+                    self.transport, tags.EMPTY, crank, tags.GRAD_ACK,
+                    live=self.live, abort=self._svc_abort(crank, gen),
                 )
 
-    def _recv_stop(self, crank: int):
-        """Count stop signals; all clients stopped => shut down I/O
-        (reference :115-129)."""
-        got = yield from aio_recv(self.transport, crank, tags.STOP, live=self.live)
+    def _recv_heartbeat(self, crank: int, gen: int = 0):
+        """Loop: consume HEARTBEAT beacons, renew the client's lease
+        (current-epoch beats only — a dead incarnation's leftovers must
+        not keep its successor's lease alive)."""
+        buf = self._hb_buf.get(crank)
+        if buf is None:
+            return
+        while self.live.on:
+            got = yield from aio_recv(
+                self.transport, crank, tags.HEARTBEAT, live=self.live,
+                out=buf, abort=self._svc_abort(crank, gen),
+            )
+            if got is None:
+                return
+            self.heartbeats_seen += 1
+            self.leases.renew(crank, int(buf[0]))
+
+    def _recv_stop(self, crank: int, gen: int = 0):
+        """Await the stop signal; all clients terminal (stopped or
+        evicted) => shut down I/O (reference :115-129)."""
+        got = yield from aio_recv(self.transport, crank, tags.STOP,
+                                  live=self.live,
+                                  abort=self._svc_abort(crank, gen))
         if got is None:
             return
-        self._stopped_clients += 1
-        if self._stopped_clients == len(self.cranks):
+        self.leases.stop(crank)
+        if self.leases.all_done():
             self.live.stop()
 
+    def _lease_reaper(self):
+        """Periodic scan: evict ACTIVE clients whose lease lapsed.  The
+        evicted client's services abort, its staging is released, and the
+        stop condition re-checks — one dead worker no longer wedges the
+        gang (the MXNET-MPI elasticity argument, PAPERS.md)."""
+        interval = max(min(self.ft.lease_ttl_s / 4.0, 1.0), 0.005)
+        while self.live.on:
+            if not (yield from aio_sleep(interval, live=self.live)):
+                return
+            for crank in self.leases.expired():
+                self.log.warning(
+                    "evicting client %d: lease expired after %.3fs without "
+                    "a heartbeat (pending ops dropped, staging released; "
+                    "it may rejoin with a bumped epoch)",
+                    crank, self.ft.lease_ttl_s,
+                )
+                self.leases.evict(crank)
+                self._gen[crank] += 1  # stale loops abort at next poll
+                self._release_client(crank)
+            if self.leases.all_done():
+                self.live.stop()
+                return
+
     # -- checkpoint / resume (beyond-reference: SURVEY §5 notes server
-    # state is never checkpointed there; here Adam/RMSProp moments
-    # survive a restart) --------------------------------------------------
+    # state is never checkpointed there; here Adam/RMSProp moments —
+    # and now the FT dedup table + per-client negotiation — survive a
+    # restart) --------------------------------------------------------------
+
+    def _client_meta(self) -> Dict[str, Dict[str, Any]]:
+        """Per-client negotiated state for the checkpoint: enough for a
+        restarted server to serve retried ops without fresh INITs."""
+        return {
+            str(c): {
+                "codec": self._codecs[c].name,
+                "framed": self._framed.get(c, False),
+                "hb": self._hb.get(c, False),
+                "epoch": self.leases.epoch(c),
+            }
+            for c in self._codecs
+        }
 
     def save_state(self, directory) -> "str":
-        """Checkpoint this server's shard param + rule state.  Call from
-        the owning thread while no grad is mid-apply (e.g. after start()
-        returns, or from a service hook between applies)."""
+        """Checkpoint this server's shard param + rule state (+ the FT
+        dedup table and client negotiation map).  Call from the owning
+        thread while no grad is mid-apply (e.g. after start() returns, or
+        from a service hook between applies).  Published via the stamped
+        atomic-publish path: versioned history plus a ``_latest`` alias a
+        concurrent loader can always trust."""
         from mpit_tpu.utils.checkpoint import save_server_state
 
         if self.param is None:
             raise RuntimeError("server holds no shard yet (init not run)")
+        if self._snap_host is not None and self._snap_host[0] == self._snap_version:
+            host = self._snap_host[1]  # reuse the snapshot cache's d2h copy
+        else:
+            host = np.asarray(self.param)
+            self._snap_host = (self._snap_version, host)
+            self.snapshot_copies += 1
         return str(save_server_state(
             directory, self.rank, self.offset, self.size,
-            np.asarray(self.param),
+            host,
             {k: np.asarray(v) for k, v in (self.rule_state or {}).items()},
-            meta={"grads_applied": self.grads_applied},
+            meta={
+                "grads_applied": self.grads_applied,
+                "snap_version": self._snap_version,
+                "dedup": self.dedup.state(),
+                "clients": self._client_meta(),
+            },
         ))
 
     def restore_state(self, path) -> None:
         """Load a shard checkpoint before start().  A restored server
         skips the client-seeding phase — start the clients with
         ``seed_servers=False`` (the resume flow; reference resume instead
-        reloads params on the client and reseeds, plaunch.lua:62)."""
+        reloads params on the client and reseeds, plaunch.lua:62).  FT
+        checkpoints also restore the dedup table and each client's
+        negotiated codec/framing, so a *restarted server* rejoins a live
+        gang: clients keep retrying into the new process and their
+        already-applied ops dedup instead of double-counting."""
         from mpit_tpu.utils.checkpoint import load_server_state
 
         if self.param is not None or self.offset != -1:
@@ -395,12 +699,24 @@ class ParamServer:
         offset, size, param, state, meta = load_server_state(path)
         self.offset, self.size = offset, size
         self.grads_applied = int(meta.get("grads_applied", 0))
+        self._snap_version = int(meta.get("snap_version", 0))
+        self.dedup.restore(meta.get("dedup", {}))
         with self._dev_ctx():
             self.param = jnp.asarray(param)
             if state:
                 self.rule_state = {k: jnp.asarray(v) for k, v in state.items()}
             else:  # stateless rule (plain add) or legacy checkpoint
                 self.rule_state = self.rule.init(self.param)
+        for crank_s, info in (meta.get("clients") or {}).items():
+            crank = int(crank_s)
+            if crank not in self.cranks:
+                continue
+            self._framed[crank] = bool(info.get("framed", False))
+            self._hb[crank] = bool(info.get("hb", False))
+            self.leases.arm(crank, int(info.get("epoch", 0)),
+                            heartbeats=self._hb[crank])
+            self._alloc_client(crank, codec_mod.get(info.get("codec", "none")))
+            self._restored_clients.add(crank)
         self._committed()
         self._restored = True
 
@@ -410,15 +726,13 @@ class ParamServer:
         more at stop.  Safe point: a ping runs one generator step, and a
         grad apply commits within one step — between pings the shard is
         never torn."""
-        import time as _time
-
-        next_save = _time.monotonic() + self._ckpt_interval
+        next_save = time.monotonic() + self._ckpt_interval
         while self.sched.queue:
             self.sched.ping_pass()
-            if _time.monotonic() >= next_save:
+            if time.monotonic() >= next_save:
                 self.save_state(self._ckpt_dir)
                 self.ckpts_written += 1
-                next_save = _time.monotonic() + self._ckpt_interval
+                next_save = time.monotonic() + self._ckpt_interval
         if self.param is not None:
             self.save_state(self._ckpt_dir)  # final state at stop
             self.ckpts_written += 1
@@ -427,46 +741,86 @@ class ParamServer:
 
     # -- orchestration (reference pserver.lua:131-157) ----------------------
 
+    def _spawn_services(self, crank: int) -> None:
+        """Phase-3 perpetual services for one client (also the rejoin
+        respawn path — hence per-generation naming)."""
+        gen = self._gen[crank]
+        self.sched.spawn(self._svc(crank, gen, self._recv_stop),
+                         name=f"recv_stop:{crank}.g{gen}")
+        self.sched.spawn(self._svc(crank, gen, self._recv_grad),
+                         name=f"recv_grad:{crank}.g{gen}")
+        self.sched.spawn(self._svc(crank, gen, self._send_param),
+                         name=f"send_param:{crank}.g{gen}")
+        if self._hb.get(crank):
+            self.sched.spawn(self._svc(crank, gen, self._recv_heartbeat),
+                             name=f"recv_heartbeat:{crank}.g{gen}")
+        if self.single_mode:
+            self.sched.spawn(self._svc(crank, gen, self._recv_param,
+                                       once=False),
+                             name=f"recv_param:{crank}.g{gen}")
+        elif self._framed.get(crank):
+            # Framed clients may retry a push whose first ack was lost;
+            # someone must keep absorbing the duplicates and re-acking
+            # after the one-shot seed service exits.  (FRESH post-seed
+            # pushes only occur in the restored-server resume flow.)
+            self.sched.spawn(
+                self._svc(crank, gen, self._recv_param, once=False,
+                          warn_unexpected=self._restored),
+                name=f"recv_param:{crank}.g{gen}")
+
     def start(self) -> None:
         """Run the server to completion (returns after the stop protocol)."""
-        # Phase 1: shard announcements from every client.
+        # Phase 1: shard announcements from every client (skipped for
+        # clients restored from an FT checkpoint — their negotiation is
+        # already in hand and no fresh INIT is coming).
         for crank in self.cranks:
-            self.sched.spawn(self._recv_init(crank), name=f"recv_init:{crank}")
+            if crank not in self._restored_clients:
+                self.sched.spawn(self._svc(crank, 0, self._recv_init),
+                                 name=f"recv_init:{crank}")
         self.sched.wait()
         # Phase 2: parameter seeding from the first client only
         # (init once & only once, reference README:64-67) — skipped on
         # resume, where the checkpoint already seeded the shard.
         seeder = self.cranks[0]
         if not self._restored:
-            self.sched.spawn(self._recv_param(seeder, once=True), name="seed_param")
+            self.sched.spawn(self._svc(seeder, 0, self._recv_param, once=True),
+                             name="seed_param")
             self.sched.wait()
         # Phase 3: perpetual services per client + stop counters.
-        if self._restored and not self.single_mode:
+        if self._restored and not self.single_mode and not self._framed.get(seeder):
             # A resume client wired with seed_servers=True would otherwise
             # block forever on its unconsumed push — accept it (client is
             # authoritative for params, as in the reference's -loadmodel
-            # reseed, plaunch.lua:62) and warn loudly.
+            # reseed, plaunch.lua:62) and warn loudly.  Framed clients get
+            # the perpetual absorb service from _spawn_services instead.
             self.sched.spawn(
-                self._recv_param(seeder, once=True, warn_unexpected=True),
+                self._svc(seeder, 0, self._recv_param, once=True,
+                          warn_unexpected=True),
                 name="unexpected_seed",
             )
         for crank in self.cranks:
-            self.sched.spawn(self._recv_stop(crank), name=f"recv_stop:{crank}")
-            self.sched.spawn(self._recv_grad(crank), name=f"recv_grad:{crank}")
-            self.sched.spawn(self._send_param(crank), name=f"send_param:{crank}")
-            if self.single_mode:
-                self.sched.spawn(
-                    self._recv_param(crank, once=False), name=f"recv_param:{crank}"
-                )
+            self._spawn_services(crank)
+        if self.ft.server_rejoin:
+            for crank in self.cranks:
+                self.sched.spawn(self._init_listener(crank),
+                                 name=f"init_listener:{crank}")
+        if self.ft.lease_ttl_s > 0:
+            self.sched.spawn(self._lease_reaper(), name="lease_reaper")
         if self._ckpt_dir:
             self._serve_with_checkpoints()
         else:
             self.sched.wait()
         self.log.debug(
-            "stopped: %d grads applied, %d params served "
-            "(%d snapshot copies, %d cache hits)",
+            "stopped: %d grads applied (%d dups re-acked, %d stale drops), "
+            "%d params served (%d snapshot copies, %d cache hits), "
+            "%d heartbeats, %d evictions, %d rejoins",
             self.grads_applied,
+            self.dup_ops,
+            self.stale_drops,
             self.params_served,
             self.snapshot_copies,
             self.snapshot_hits,
+            self.heartbeats_seen,
+            self.leases.evictions,
+            self.rejoins,
         )

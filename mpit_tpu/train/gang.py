@@ -41,7 +41,21 @@ def child_transport(cfg: Config, rank: int, size: int):
                 f"transport=tcp needs {size} comma-separated tcp_addrs, "
                 f"got {len(addrs)}"
             )
-        transport = TcpTransport(rank, size, addrs)
+        dial_peers = None
+        if os.environ.get("MPIT_FT_REJOIN", "0") not in ("0", ""):
+            # A supervisor-restarted worker joins a mid-run gang: only
+            # its servers must be reachable — a sibling worker that
+            # already finished and exited is not a failure (PS traffic
+            # is client<->server only; the barrier is skipped on rejoin).
+            from mpit_tpu.train.launch import assign_roles
+
+            sranks, _cranks, _tester = assign_roles(
+                size, int(cfg.get("master_freq", 2)),
+                str(cfg.get("tester", "none")),
+            )
+            if rank not in sranks:
+                dial_peers = [r for r in sranks if r < rank]
+        transport = TcpTransport(rank, size, addrs, dial_peers=dial_peers)
     else:
         from mpit_tpu.comm.shm import ShmTransport
 
@@ -54,6 +68,35 @@ def child_transport(cfg: Config, rank: int, size: int):
 
         HostCollectives(transport).barrier()
     return transport
+
+
+def spawn_rank(
+    child_module: str, cfg: Config, rank: int, size: int, logdir: str,
+    extra_env: Optional[Dict[str, str]] = None,
+) -> tuple:
+    """Spawn one ``--child`` rank process; returns (proc, logpath,
+    resultpath).  The single spawn path shared by :func:`launch_gang`
+    and the fault-tolerance supervisor (mpit_tpu.ft.supervisor), which
+    re-invokes it to restart a dead rank — logs open in append mode so a
+    restarted incarnation continues the same rank log.  ``cfg`` is
+    serialized per call, so a restart may carry a modified config
+    (barrier off, resume on) without touching its gang-mates."""
+    logpath = os.path.join(logdir, f"rank{rank}.log")
+    resultpath = os.path.join(logdir, f"rank{rank}.result.json")
+    env = {
+        **os.environ,
+        "MPIT_SIZE": str(size),
+        "MPIT_CFG": json.dumps(cfg.to_dict()),
+        "MPIT_RANK": str(rank),
+        "MPIT_RESULT_FILE": resultpath,
+    }
+    env.update(extra_env or {})
+    with open(logpath, "a") as fh:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", child_module, "--child"],
+            env=env, stdout=fh, stderr=subprocess.STDOUT, text=True,
+        )
+    return proc, logpath, resultpath
 
 
 def launch_gang(
@@ -69,30 +112,19 @@ def launch_gang(
     size = int(cfg.np)
     namespace = cfg.get("namespace") or f"mpit{os.getpid()}"
     cfg = cfg.merged(namespace=namespace)
-    env_base = {
-        **os.environ,
-        "MPIT_SIZE": str(size),
-        "MPIT_CFG": json.dumps(cfg.to_dict()),
-    }
     # Children write to per-rank log files, not pipes: nobody needs to
     # drain them while the gang runs, so a log-heavy child can never block
     # on a full pipe buffer mid-run.
     logdir = tempfile.mkdtemp(prefix=f"{namespace}_logs_")
     procs, logfiles, resultfiles = [], [], []
     for rank in range(size):
-        logpath = os.path.join(logdir, f"rank{rank}.log")
-        resultpath = os.path.join(logdir, f"rank{rank}.result.json")
+        proc, logpath, resultpath = spawn_rank(
+            child_module, cfg, rank, size, logdir,
+            extra_env=(env_overrides or {}).get(rank),
+        )
+        procs.append(proc)
         logfiles.append(logpath)
         resultfiles.append(resultpath)
-        env = {**env_base, "MPIT_RANK": str(rank), "MPIT_RESULT_FILE": resultpath}
-        env.update((env_overrides or {}).get(rank, {}))
-        with open(logpath, "w") as fh:
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, "-m", child_module, "--child"],
-                    env=env, stdout=fh, stderr=subprocess.STDOUT, text=True,
-                )
-            )
     deadline = time.monotonic() + timeout
     failed: Optional[int] = None
     timed_out = False

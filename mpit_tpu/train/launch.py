@@ -66,7 +66,40 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     # environment disagrees fails its INIT loudly instead of training on
     # corrupt frames.
     codec="",
+    # Fault tolerance (mpit_tpu.ft; 0 = off, the legacy wire).  Heartbeat
+    # interval for workers, lease TTL for servers (expired => eviction),
+    # per-op deadline for workers (enables retry + FT frame headers), and
+    # supervise = restarts allowed per rank (the supervisor respawns dead
+    # ranks with a bumped epoch; workers rejoin via INIT v3, servers
+    # resume from their stamped shard snapshot — needs server_ckpt_dir).
+    ft_heartbeat_s=0.0,
+    ft_lease_ttl_s=0.0,
+    ft_op_deadline_s=0.0,
+    ft_max_retries=8,
+    supervise=0,
 )
+
+
+def ft_from_cfg(cfg: Config):
+    """FTConfig for one rank: env base (the supervisor's restart env —
+    MPIT_FT_EPOCH/MPIT_FT_REJOIN — rides there) with the launch config's
+    non-zero knobs layered on top."""
+    from mpit_tpu.ft import FTConfig
+
+    overrides = {}
+    for ck, fk, cast in (
+        ("ft_heartbeat_s", "heartbeat_s", float),
+        ("ft_lease_ttl_s", "lease_ttl_s", float),
+        ("ft_op_deadline_s", "op_deadline_s", float),
+    ):
+        value = cast(cfg.get(ck, 0) or 0)
+        if value:
+            overrides[fk] = value
+    if overrides.get("op_deadline_s"):
+        overrides["max_retries"] = int(cfg.get("ft_max_retries", 8))
+    if overrides.get("lease_ttl_s") or int(cfg.get("supervise", 0)):
+        overrides["rejoin"] = True
+    return FTConfig.from_env(**overrides)
 
 
 def assign_roles(
@@ -128,6 +161,7 @@ def run_rank(
         from mpit_tpu.train.tester import run_tester
 
         return {"role": "tester", **run_tester(rank, sranks, cfg, transport, data)}
+    ft = ft_from_cfg(cfg)
     if rank in sranks:
         # The tester counts as a (pull-only) client: it announces shards and
         # participates in the stop protocol like any worker.
@@ -139,6 +173,7 @@ def run_rank(
             ckpt_dir=ckpt_dir or None,
             ckpt_interval=float(cfg.get("server_ckpt_interval", 30.0)),
             codec=str(cfg.get("codec", "") or "") or None,
+            ft=ft,
         )
         if bool(cfg.get("resume", False)):
             import pathlib
@@ -160,11 +195,18 @@ def run_rank(
             "ckpts_written": server.ckpts_written,
         }
     # On resume the restored servers are authoritative for params — no
-    # client re-seeds (ps/server.py restore_state contract).
+    # client re-seeds (ps/server.py restore_state contract).  Same for a
+    # supervisor-restarted worker rejoining mid-run (MPIT_FT_REJOIN): the
+    # live servers hold the current center, and a re-seed would rewind it.
+    import os as _os
+
+    rejoining = _os.environ.get("MPIT_FT_REJOIN", "0") not in ("0", "")
     pclient = ParamClient(
         rank, sranks, transport,
-        seed_servers=(rank == cranks[0]) and not bool(cfg.get("resume", False)),
+        seed_servers=(rank == cranks[0])
+        and not bool(cfg.get("resume", False)) and not rejoining,
         codec=str(cfg.get("codec", "") or "") or None,
+        ft=ft,
     )
     trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
     log.info("worker with servers %s", sranks)
@@ -218,6 +260,20 @@ def launch_processes(cfg: Config, timeout: float = 3600.0) -> Dict[int, Dict[str
     if cfg.opt not in MnistTrainer.KNOWN_OPTS:
         raise ValueError(
             f"unknown optimizer {cfg.opt!r}; have {MnistTrainer.KNOWN_OPTS}"
+        )
+    restarts = int(cfg.get("supervise", 0))
+    if restarts > 0:
+        from mpit_tpu.ft.supervisor import RestartPolicy, supervise_gang
+
+        sranks, _cranks, _tester = assign_roles(
+            int(cfg.np), int(cfg.get("master_freq", 2)),
+            str(cfg.get("tester", "none")),
+        )
+        return supervise_gang(
+            "mpit_tpu.train.launch", cfg, timeout,
+            policy=RestartPolicy(max_restarts=restarts),
+            env_overrides=device_env_overrides(cfg, int(cfg.np)),
+            server_ranks=sranks,
         )
     from mpit_tpu.train.gang import launch_gang
 

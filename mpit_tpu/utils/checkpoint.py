@@ -102,17 +102,20 @@ def save_server_state(
     param: Any,
     rule_state: Optional[Dict[str, Any]],
     meta: Optional[Dict[str, Any]] = None,
+    keep: int = 3,
 ) -> pathlib.Path:
     """Checkpoint one server's shard: param slice + rule (optimizer) state.
 
     The reference never checkpoints server state (SURVEY §5 — only whole
     params from the tester); this closes that gap so an Adam/RMSProp
-    server resumes with its moments instead of cold ones.  Layout: one
-    ``.npz`` per server rank, atomic via temp + replace."""
-    import os
-
-    directory = pathlib.Path(directory)
-    directory.mkdir(parents=True, exist_ok=True)
+    server resumes with its moments instead of cold ones.  Published via
+    :func:`_stamped_atomic_publish`: a millisecond-stamped version plus
+    the ``server<rank>_latest.npz`` alias a loader (resume, a supervisor
+    restarting the rank) can always open mid-write-free.  The stamped
+    history is pruned to the newest ``keep`` — a fault-tolerant server
+    snapshots every ``ckpt_interval`` seconds indefinitely, and an
+    unbounded history would fill the disk long before anyone needed a
+    snapshot older than a restart or two."""
     payload: Dict[str, Any] = {}
     _pack_array("param", param, payload)
     state = dict(rule_state or {})
@@ -123,14 +126,15 @@ def save_server_state(
         "state_keys": sorted(state), "runtime": time.time(),
         **(meta or {}),
     })
-    path = directory / f"server{rank}_latest.npz"
-    tmp = directory / f".server{rank}.tmp{os.getpid()}"
-    try:
-        with open(tmp, "wb") as f:
-            np.savez(f, **payload)
-        os.replace(tmp, path)
-    finally:
-        tmp.unlink(missing_ok=True)
+    prefix = f"server{rank}"
+    path = _stamped_atomic_publish(directory, prefix, payload)
+    if keep > 0:
+        stamped = sorted(
+            p for p in pathlib.Path(directory).glob(f"{prefix}_*.npz")
+            if p.name[len(prefix) + 1 : -len(".npz")].isdigit()
+        )
+        for old in stamped[:-keep]:
+            old.unlink(missing_ok=True)
     return path
 
 

@@ -15,3 +15,8 @@ def serve_req(transport):
     # Half of the seeded MT-P104 cycle: REPLY only after REQ.
     yield from aio_recv(transport, 1, tags.REQ)
     yield from aio_send(transport, b"", 1, tags.REPLY)
+
+
+def drain(transport):
+    # MT-P202: blocking transport convenience — unbounded busy-wait.
+    return transport.recv(1, tags.GRAD)

@@ -4,11 +4,15 @@ import tags
 from aio import aio_recv, aio_send
 
 
-def send_grad(transport, grad, live):
-    yield from aio_send(transport, grad, 0, tags.GRAD, live=live)
-    yield from aio_recv(transport, 0, tags.GRAD_ACK, live=live)
+def send_grad(transport, grad, live, deadline):
+    yield from aio_send(transport, grad, 0, tags.GRAD, live=live,
+                        deadline=deadline)
+    yield from aio_recv(transport, 0, tags.GRAD_ACK, live=live,
+                        deadline=deadline)
 
 
-def recv_param(transport, out, live):
-    yield from aio_send(transport, b"", 0, tags.PARAM_REQ, live=live)
-    yield from aio_recv(transport, 0, tags.PARAM, live=live, out=out)
+def recv_param(transport, out, live, deadline):
+    yield from aio_send(transport, b"", 0, tags.PARAM_REQ, live=live,
+                        deadline=deadline)
+    yield from aio_recv(transport, 0, tags.PARAM, live=live, out=out,
+                        deadline=deadline)

@@ -4,12 +4,15 @@ import tags
 from aio import aio_recv, aio_send
 
 
-def serve_grad(transport, buf, live):
-    got = yield from aio_recv(transport, 1, tags.GRAD, out=buf, live=live)
-    yield from aio_send(transport, b"", 1, tags.GRAD_ACK, live=live)
+def serve_grad(transport, buf, live, gone):
+    got = yield from aio_recv(transport, 1, tags.GRAD, out=buf, live=live,
+                              abort=gone)
+    yield from aio_send(transport, b"", 1, tags.GRAD_ACK, live=live,
+                        abort=gone)
     return got
 
 
-def serve_param(transport, snapshot, live):
-    yield from aio_recv(transport, 1, tags.PARAM_REQ, live=live)
-    yield from aio_send(transport, snapshot, 1, tags.PARAM, live=live)
+def serve_param(transport, snapshot, live, gone):
+    yield from aio_recv(transport, 1, tags.PARAM_REQ, live=live, abort=gone)
+    yield from aio_send(transport, snapshot, 1, tags.PARAM, live=live,
+                        abort=gone)

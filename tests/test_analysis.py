@@ -78,6 +78,17 @@ class TestSeededViolations:
         locs = {(f.path, f.line) for f in bad.get("MT-C202", [])}
         assert ("locks.py", 27) in locs
 
+    def test_unbounded_aio_detected(self, bad):
+        # MT-P201: every badpkg aio call lacks deadline=/abort=.
+        locs = {(f.path, f.line) for f in bad.get("MT-P201", [])}
+        assert ("client.py", 9) in locs
+        assert ("server.py", 16) in locs
+
+    def test_blocking_convenience_detected(self, bad):
+        # MT-P202: the seeded transport.recv() busy-wait in drain().
+        hits = bad.get("MT-P202", [])
+        assert [(f.path, f.line) for f in hits] == [("server.py", 22)]
+
     def test_yield_under_lock_detected(self, bad):
         hits = bad.get("MT-C203", [])
         assert [(f.path, f.line) for f in hits] == [("locks.py", 31)]
