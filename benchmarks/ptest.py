@@ -66,8 +66,15 @@ GANG = os.environ.get("MPIT_BENCH_GANG", "procs")
 # Heartbeats only; FT frame headers (op deadlines) are a different mode
 # with a known staging-copy cost and are not part of this sweep.
 HEARTBEAT_SWEEP = os.environ.get("MPIT_BENCH_HEARTBEAT", "") not in ("", "0")
+# MPIT_BENCH_OBS=1: run each shm leg twice — observability (registry
+# counters + op spans, MPIT_OBS) off, then on — mirroring the heartbeat
+# sweep, so the instrumentation tax on the PS hot path is a measured
+# number.  The trace *exporter* is not part of the sweep (it runs at
+# exit, off the timed window); what this measures is the per-op span
+# and per-message counter cost.
+OBS_SWEEP = os.environ.get("MPIT_BENCH_OBS", "") not in ("", "0")
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
-# (heartbeats on or off) lands below 97% of this reference — the
+# (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
 BASELINE = float(os.environ.get("MPIT_BENCH_BASELINE", "0") or 0)
 
@@ -88,10 +95,13 @@ def bench_ici() -> dict:
     }
 
 
-def bench_shm(codec: str = "", heartbeat: bool = False) -> dict:
+def bench_shm(codec: str = "", heartbeat: bool = False,
+              obs: bool = False) -> dict:
     """One shm PS push/pull measurement; ``codec`` overrides
     MPIT_PS_CODEC for the gang (read at client/server construction);
-    ``heartbeat`` arms client beacons + the server lease registry."""
+    ``heartbeat`` arms client beacons + the server lease registry;
+    ``obs`` enables the observability registry + op spans (MPIT_OBS)
+    inside every gang child."""
     import numpy as np
 
     from mpit_tpu.comm import codec as codec_mod
@@ -102,14 +112,20 @@ def bench_shm(codec: str = "", heartbeat: bool = False) -> dict:
     size = int(MB * (1 << 20) / 4)
     _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, codec "
          f"{codec_name}, heartbeat {'on' if heartbeat else 'off'}, "
+         f"obs {'on' if obs else 'off'}, "
          f"payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
 
-    if heartbeat and GANG != "procs":
-        raise RuntimeError("MPIT_BENCH_HEARTBEAT needs MPIT_BENCH_GANG=procs")
-    run = _shm_run_procs if GANG == "procs" else _shm_run_threads
-    runs = [run(size, heartbeat=heartbeat) for _ in range(REPS)]
+    if (heartbeat or obs) and GANG != "procs":
+        raise RuntimeError(
+            "MPIT_BENCH_HEARTBEAT/MPIT_BENCH_OBS need MPIT_BENCH_GANG=procs")
+    if GANG == "procs":
+        runs = [_shm_run_procs(size, heartbeat=heartbeat, obs=obs)
+                for _ in range(REPS)]
+    else:
+        runs = [_shm_run_threads(size, heartbeat=heartbeat)
+                for _ in range(REPS)]
     mbs = float(np.median(np.asarray(runs)))
-    _log(f"[shm] codec {codec_name} hb={int(heartbeat)}: "
+    _log(f"[shm] codec {codec_name} hb={int(heartbeat)} obs={int(obs)}: "
          f"median {mbs:.1f} MB/s over {runs}")
     return {
         "metric": "ps_pushpull_bandwidth_shm",
@@ -117,6 +133,7 @@ def bench_shm(codec: str = "", heartbeat: bool = False) -> dict:
         "unit": "MB/s",
         "codec": codec_name,
         "heartbeat": int(heartbeat),
+        "obs": int(obs),
         "gang": GANG,
         "reps": REPS,
         "value_runs": [round(v, 1) for v in runs],
@@ -140,7 +157,8 @@ def _ring_bytes(size: int) -> int:
     return max(64 << 20, 2 * peers * shard_bytes + (16 << 20))
 
 
-def _shm_run_procs(size: int, heartbeat: bool = False) -> float:
+def _shm_run_procs(size: int, heartbeat: bool = False,
+                   obs: bool = False) -> float:
     """One timed gang, one OS process per rank: servers run the PS serve
     loop, clients run T rounds of {pull, push, wait} and report their
     round-loop window; aggregate MB/s uses the union of the client
@@ -165,7 +183,11 @@ def _shm_run_procs(size: int, heartbeat: bool = False) -> float:
         env = dict(
             os.environ, JAX_PLATFORMS="cpu", PTEST_GANG=json.dumps(spec),
             PTEST_RANK=str(rank), PTEST_RESULT=result_path,
+            # Explicit either way: the A/B must measure the obs
+            # machinery, not whatever MPIT_OBS the caller env carries.
+            MPIT_OBS="1" if obs else "0",
         )
+        env.pop("MPIT_OBS_TRACE", None)  # tracing implies obs; keep A/B clean
         with open(log_path, "w") as fh:
             procs.append(subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--gang-child"],
@@ -368,16 +390,19 @@ def main():
     results = []
     sweep = CODECS or [""]
     hb_modes = [False, True] if HEARTBEAT_SWEEP else [False]
+    obs_modes = [False, True] if OBS_SWEEP else [False]
     if MODE in ("ici", "both"):
         results.append(bench_ici())
     if MODE == "shm":
-        results.extend(bench_shm(c, hb) for c in sweep for hb in hb_modes)
+        results.extend(bench_shm(c, hb, ob) for c in sweep
+                       for hb in hb_modes for ob in obs_modes)
     elif MODE == "both":
         if GANG == "procs":
             # Every rank is its own child process with JAX_PLATFORMS=cpu;
             # this parent keeps the accelerator for the ici leg and never
             # touches jax on the shm path.
-            results.extend(bench_shm(c, hb) for c in sweep for hb in hb_modes)
+            results.extend(bench_shm(c, hb, ob) for c in sweep
+                           for hb in hb_modes for ob in obs_modes)
         else:
             results.extend(_bench_shm_subprocess(c) for c in sweep)
     for r in results:

@@ -20,6 +20,8 @@ import time
 from typing import Any, Callable, Generator, Optional
 
 from mpit_tpu.aio.queue import Queue
+from mpit_tpu.obs import metrics as _obs_metrics
+from mpit_tpu.obs import spans as _obs_spans
 
 # Idle backoff (microseconds) for the wait loops: after a full pass over
 # the queue completes NO task, the waiter sleeps this long before polling
@@ -84,7 +86,8 @@ class Task:
     ``result`` holds the generator's return value once state is DONE.
     """
 
-    __slots__ = ("gen", "name", "state", "result", "error", "on_done")
+    __slots__ = ("gen", "name", "state", "result", "error", "on_done",
+                 "t_obs")
 
     def __init__(
         self,
@@ -98,6 +101,7 @@ class Task:
         self.result: Any = None
         self.error: Optional[BaseException] = None
         self.on_done = on_done
+        self.t_obs: Any = None  # span-recorder token (None when disabled)
 
     def step(self) -> str:
         """Advance the generator one yield.  Returns the new state."""
@@ -134,6 +138,14 @@ class Scheduler:
         self.errors: list[TaskError] = []
         self.idle_usec = IDLE_USEC if idle_usec is None else float(idle_usec)
         self._completions = 0
+        # Observability (mpit_tpu.obs): instruments are captured once —
+        # disabled they are the shared null objects, so the per-step and
+        # idle accounting below costs one no-op method call.
+        self._rec = _obs_spans.get_recorder()
+        _reg = _obs_metrics.get_registry()
+        self._m_steps = _reg.counter("mpit_aio_steps_total")
+        self._m_idle = _reg.counter("mpit_aio_idle_seconds_total")
+        self._m_tasks = _reg.counter("mpit_aio_tasks_total")
 
     # -- co_execute ---------------------------------------------------------
     def spawn(
@@ -144,6 +156,8 @@ class Scheduler:
     ) -> Task:
         """Create a task, prime it with one step, queue it if still running."""
         task = Task(gen, name=name, on_done=on_done)
+        self._m_tasks.inc()
+        task.t_obs = self._rec.task_begin(name)
         self._step_and_requeue(task)
         return task
 
@@ -176,6 +190,7 @@ class Scheduler:
             # Full pass, nothing finished: yield the core (see IDLE_USEC)
             # instead of burning it on iprobe spins.
             time.sleep(self.idle_usec * 1e-6)
+            self._m_idle.inc(self.idle_usec * 1e-6)
         return progressed
 
     # -- co_wait ------------------------------------------------------------
@@ -214,13 +229,16 @@ class Scheduler:
 
     def _step_and_requeue(self, task: Task) -> None:
         state = task.step()
+        self._m_steps.inc()
         if state == EXEC:
             self.queue.push(task)
         elif state == ERR:
             self._completions += 1
+            self._rec.task_end(task.t_obs, task.name, ERR)
             self.errors.append(TaskError(task, task.error))  # type: ignore[arg-type]
         elif state == DONE:
             self._completions += 1
+            self._rec.task_end(task.t_obs, task.name, DONE)
 
     def __len__(self) -> int:
         return len(self.queue)

@@ -39,6 +39,9 @@ RULES: Dict[str, Tuple[str, str]] = {
     "MT-J301": (ERROR, "host-device sync inside a jitted function"),
     "MT-J302": (WARN, "Python branch on a traced value inside a jitted function"),
     "MT-J303": (INFO, "jitted update/step function without donate_argnums"),
+    # -- observability (the mpit_tpu.obs contract) -------------------------
+    "MT-O401": (WARN, "hand-rolled clock timing in a role file — use obs spans/registry"),
+    "MT-O402": (WARN, "print() reporting in a role file — use an obs snapshot or the logger"),
     # -- engine ------------------------------------------------------------
     "MT-X001": (ERROR, "file does not parse"),
 }

@@ -1,0 +1,134 @@
+"""Observability rules (MT-O4xx) — role code reports through obs.
+
+With ``mpit_tpu.obs`` in place, hand-rolled instrumentation in the role
+layers (``ps/``, ``ft/``, ``comm/``, plus any ``*client*``/``*server*``
+module) is a regression: a ``time.monotonic()`` pair produces a number
+nobody exports, and a ``print()`` produces a line nobody can aggregate —
+both invisible to the registry snapshot, the Prometheus exposition and
+the Chrome trace.  Two rules:
+
+- **MT-O401** — hand-rolled timing: any ``time.time()`` /
+  ``time.perf_counter()`` call (role files have no business on the
+  wall/bench clocks — deadlines use monotonic arithmetic, durations
+  belong to obs spans / ``registry.timer``), or an elapsed-time
+  subtraction whose *both* operands derive from clock calls in the same
+  scope (``time.monotonic() - t0`` where ``t0`` was read from a clock).
+  Deadline arithmetic (``time.monotonic() + ttl``, comparisons,
+  ``deadline - time.monotonic()`` remaining-time) is deliberately not
+  flagged — bounding a wait is protocol, measuring one is obs's job.
+- **MT-O402** — ``print()`` reporting: render from a registry snapshot
+  (``Registry.format_summary``) or the module logger instead.
+  Deliberate operator output (child-log echo at gang teardown, CLI
+  entry points) carries baseline suppressions with reasons.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import List, Set, Tuple
+
+from mpit_tpu.analysis.core import Finding, SourceFile, callee_name, root_name
+
+_SCOPE_DIRS = {"ps", "ft", "comm"}
+_CLOCKS = {"time", "monotonic", "perf_counter"}
+_WALL_CLOCKS = {"time", "perf_counter"}
+
+
+def _in_scope(src: SourceFile) -> bool:
+    parts = pathlib.PurePosixPath(src.rel).parts
+    if any(p in _SCOPE_DIRS for p in parts[:-1]):
+        return True
+    stem = src.path.stem.lower()
+    return "client" in stem or "server" in stem
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and callee_name(node) in _CLOCKS
+            and isinstance(node.func, ast.Attribute)
+            and root_name(node.func) == "time")
+
+
+def _scopes(tree: ast.Module):
+    """(qualname, body-statement list) per function plus the module top
+    level; nested defs belong to their own scope."""
+    yield "<module>", list(ast.iter_child_nodes(tree))
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield f"{prefix}{child.name}", list(ast.iter_child_nodes(child))
+                yield from walk(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def _walk_shallow(nodes):
+    """Walk statements without descending into nested defs (their bodies
+    are separate scopes)."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_scope(src: SourceFile, qual: str, body,
+                 seen: Set[Tuple[str, int]], findings: List[Finding]) -> None:
+    clocked: Set[str] = set()
+    nodes = list(_walk_shallow(body))
+    # Pass 1: names assigned from clock reads (order-free: generators
+    # and loops make lexical order unreliable).
+    for node in nodes:
+        if isinstance(node, ast.Assign) and _is_clock_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    clocked.add(tgt.id)
+
+    def clock_rooted(expr: ast.AST) -> bool:
+        return _is_clock_call(expr) or (
+            isinstance(expr, ast.Name) and expr.id in clocked)
+
+    def emit(rule: str, node: ast.AST, msg: str) -> None:
+        key = (rule, node.lineno)
+        if key not in seen:
+            seen.add(key)
+            findings.append(src.finding(rule, node, msg))
+
+    for node in nodes:
+        if _is_clock_call(node) and callee_name(node) in _WALL_CLOCKS:
+            emit("MT-O401", node,
+                 f"{qual} reads time.{callee_name(node)}() in a role file — "
+                 "wall/bench clocks are hand-rolled timing; route durations "
+                 "through mpit_tpu.obs (spans or registry.timer)")
+        elif (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                and clock_rooted(node.left) and clock_rooted(node.right)):
+            emit("MT-O401", node,
+                 f"{qual} computes an elapsed time by subtracting clock "
+                 "reads — use an obs span or registry.timer so the "
+                 "measurement reaches the registry/trace")
+        elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            emit("MT-O402", node,
+                 f"{qual} reports via print() in a role file — render from "
+                 "an obs registry snapshot (format_summary/exposition) or "
+                 "the module logger")
+
+
+def check(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        if not _in_scope(src):
+            continue
+        seen: Set[Tuple[str, int]] = set()
+        for qual, body in _scopes(src.tree):
+            _check_scope(src, qual, body, seen, findings)
+    return findings

@@ -47,6 +47,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from mpit_tpu.obs import metrics as _obs
+
 _LITTLE = sys.byteorder == "little"
 
 # Native kernels (comm/native/transport.cpp, mt_codec_*): the same math
@@ -116,11 +118,37 @@ class Codec:
         """Encode float32 ``x`` into the uint8 ``wire`` buffer.  With
         ``residual`` (same shape as ``x``), quantize ``x + residual``
         and store the new quantization error back into ``residual``
-        (error feedback — gradient path only)."""
-        raise NotImplementedError
+        (error feedback — gradient path only).
+
+        Observability: encode time and produced wire bytes feed the obs
+        registry (``mpit_codec_*``) when obs is enabled; disabled, the
+        wrap is one ``enabled`` attribute read per call (the clock lives
+        in the registry timer, never here — the MT-O4xx contract)."""
+        reg = _obs.get_registry()
+        if not reg.enabled:
+            self._encode_into(x, wire, residual)
+            return
+        with reg.timer("mpit_codec_encode_seconds", codec=self.name):
+            self._encode_into(x, wire, residual)
+        reg.counter("mpit_codec_encode_bytes_total",
+                    codec=self.name).inc(int(wire.nbytes))
 
     def decode_into(self, wire: np.ndarray, out: np.ndarray) -> None:
-        """Decode a frame into the float32 ``out`` buffer (host path)."""
+        """Decode a frame into the float32 ``out`` buffer (host path).
+        Timed into the obs registry like :meth:`encode_into`."""
+        reg = _obs.get_registry()
+        if not reg.enabled:
+            self._decode_into(wire, out)
+            return
+        with reg.timer("mpit_codec_decode_seconds", codec=self.name):
+            self._decode_into(wire, out)
+        reg.counter("mpit_codec_decode_bytes_total",
+                    codec=self.name).inc(int(wire.nbytes))
+
+    def _encode_into(self, x, wire, residual=None) -> None:
+        raise NotImplementedError
+
+    def _decode_into(self, wire, out) -> None:
         raise NotImplementedError
 
     def split_wire(self, wire: np.ndarray, size: int) -> List[np.ndarray]:
@@ -142,10 +170,10 @@ class NoneCodec(Codec):
     def wire_nbytes(self, size: int) -> int:
         return 4 * size
 
-    def encode_into(self, x, wire, residual=None):
+    def _encode_into(self, x, wire, residual=None):
         wire.view(np.float32)[: x.size] = x
 
-    def decode_into(self, wire, out):
+    def _decode_into(self, wire, out):
         out[:] = wire.view(np.float32)[: out.size]
 
     def split_wire(self, wire, size):
@@ -162,7 +190,7 @@ class Bf16Codec(Codec):
     def wire_nbytes(self, size: int) -> int:
         return 2 * size
 
-    def encode_into(self, x, wire, residual=None):
+    def _encode_into(self, x, wire, residual=None):
         # Truncation: keep the top 16 bits of the fp32 word.  On a
         # little-endian host that is one strided copy of the high
         # half-words — no whole-shard uint32 temporaries, which at the
@@ -180,7 +208,7 @@ class Bf16Codec(Codec):
                 x.view(np.uint32) >> 16
             ).astype(np.uint16)
 
-    def decode_into(self, wire, out):
+    def _decode_into(self, wire, out):
         lib = _native()
         if lib is not None:
             lib.mt_codec_bf16_decode(wire, out.size, out)
@@ -218,7 +246,7 @@ class Int8Codec(Codec):
         codes = wire[4 * nb : 4 * nb + size].view(np.int8)
         return scales, codes
 
-    def encode_into(self, x, wire, residual=None):
+    def _encode_into(self, x, wire, residual=None):
         # Cache-tiled and pass-frugal on purpose: the encoder competes
         # with the wire for the same memory bandwidth, so every DRAM
         # sweep shows up 1:1 in PS throughput.  The slice is processed
@@ -284,7 +312,7 @@ class Int8Codec(Codec):
                 t *= scales[nb - 1]
                 np.subtract(tail, t, out=residual[main:])
 
-    def decode_into(self, wire, out):
+    def _decode_into(self, wire, out):
         # Tiled like encode_into: dequantize straight into the caller's
         # slice, int8->f32 cast riding the same cache-resident pass as
         # the scale multiply.

@@ -25,6 +25,7 @@ from typing import Any, Optional
 import numpy as np
 
 from mpit_tpu.comm.transport import Handle, Transport
+from mpit_tpu.obs import metrics as _obs
 
 
 @functools.lru_cache(maxsize=1)
@@ -53,6 +54,21 @@ class ShmTransport(Transport):
                 f"mt_init failed for namespace={namespace!r} rank={rank}"
             )
         self._closed = False
+        # Per-peer traffic counters (mpit_tpu.obs): rank-indexed lists,
+        # null singletons when obs is disabled (no-op on the hot path).
+        _reg = _obs.get_registry()
+        self._m_tx_msgs = [_reg.counter("mpit_shm_tx_messages_total",
+                                        rank=rank, peer=r)
+                           for r in range(nranks)]
+        self._m_tx_bytes = [_reg.counter("mpit_shm_tx_bytes_total",
+                                         rank=rank, peer=r)
+                            for r in range(nranks)]
+        self._m_rx_msgs = [_reg.counter("mpit_shm_rx_messages_total",
+                                        rank=rank, peer=r)
+                           for r in range(nranks)]
+        self._m_rx_bytes = [_reg.counter("mpit_shm_rx_bytes_total",
+                                         rank=rank, peer=r)
+                            for r in range(nranks)]
         atexit.register(self.close)
 
     # -- Transport ----------------------------------------------------------
@@ -63,6 +79,8 @@ class ShmTransport(Transport):
         native = self.lib.mt_isend(self._ctx, dst, tag, buf, nbytes)
         if native < 0:
             raise ValueError(f"isend to invalid rank {dst}")
+        self._m_tx_msgs[dst].inc()
+        self._m_tx_bytes[dst].inc(nbytes)
         return Handle(kind="send", peer=dst, tag=tag, buf=buf, native_id=native)
 
     def irecv(self, src: int, tag: int, out: Any | None = None) -> Handle:
@@ -109,6 +127,11 @@ class ShmTransport(Transport):
             if handle.kind == "recv" and handle.meta.get("as_bytes"):
                 handle.payload = handle.out.tobytes()
                 handle.out = None
+            if handle.kind == "recv":
+                out = handle.out if handle.out is not None else handle.payload
+                self._m_rx_msgs[handle.peer].inc()
+                self._m_rx_bytes[handle.peer].inc(
+                    int(getattr(out, "nbytes", None) or len(out or b"")))
             if handle.kind == "send":
                 handle.buf = None  # release ownership back to the caller
             self.lib.mt_release(self._ctx, handle.native_id)

@@ -42,6 +42,7 @@ from mpit_tpu.comm.transport import (
     as_bytes_view,
     as_writable_view,
 )
+from mpit_tpu.obs import metrics as _obs
 
 _HDR = struct.Struct("<qqq")  # tag, size, seq
 # rank, instance nonce, last-seq-from-you, address-book digest (the
@@ -186,6 +187,22 @@ class TcpTransport(Transport):
         self._threads: List[threading.Thread] = []
         self._disconnect_seen: set = set()
         self._closed = False
+        # Per-peer traffic counters (mpit_tpu.obs): indexed by rank so
+        # the hot paths never hash a label dict; the shared null
+        # instrument fills every slot when obs is disabled.
+        _reg = _obs.get_registry()
+        self._m_tx_msgs = [_reg.counter("mpit_tcp_tx_messages_total",
+                                        rank=rank, peer=r)
+                           for r in range(nranks)]
+        self._m_tx_bytes = [_reg.counter("mpit_tcp_tx_bytes_total",
+                                         rank=rank, peer=r)
+                            for r in range(nranks)]
+        self._m_rx_msgs = [_reg.counter("mpit_tcp_rx_messages_total",
+                                        rank=rank, peer=r)
+                           for r in range(nranks)]
+        self._m_rx_bytes = [_reg.counter("mpit_tcp_rx_bytes_total",
+                                         rank=rank, peer=r)
+                            for r in range(nranks)]
 
         host, _, port = addresses[rank].rpartition(":")
         if listener is None:
@@ -515,6 +532,8 @@ class TcpTransport(Transport):
                     if seq > self._last_seq[peer]:
                         self._last_seq[peer] = seq
                         self._channels[(peer, int(tag))].msgs.append(payload)
+                        self._m_rx_msgs[peer].inc()
+                        self._m_rx_bytes[peer].inc(len(payload))
                     # else: duplicate from a reconnect resend — drop it,
                     # but still re-ack (the original ack may be exactly
                     # what the tear swallowed).
@@ -740,6 +759,8 @@ class TcpTransport(Transport):
                  view, self._send_seq[dst])
             )
             cv.notify()
+        self._m_tx_msgs[dst].inc()
+        self._m_tx_bytes[dst].inc(view.nbytes)
         return handle
 
     def irecv(self, src: int, tag: int, out: Any | None = None) -> Handle:

@@ -39,6 +39,7 @@ from typing import Any, Optional
 
 from mpit_tpu.comm.transport import Handle, Transport
 from mpit_tpu.ft.retry import _splitmix64
+from mpit_tpu.obs import metrics as _obs
 
 ENV = "MPIT_FT_FAULT_PLAN"
 
@@ -142,10 +143,29 @@ class FaultyTransport(Transport):
         self.nranks = inner.nranks
         self._counts: dict = {}  # (dst, tag) -> messages seen
         self._sent_to: dict = {}  # dst -> total sends attempted
-        self.dropped = 0
-        self.duplicated = 0
-        self.delayed = 0
         self.severed: set = set()
+        # Injected-fault counters ride the obs registry (null when obs
+        # is disabled, but the attribute surface below always counts —
+        # tests and chaos harnesses read .dropped/.duplicated/.delayed).
+        reg = _obs.registry_or_local()
+        self._m_dropped = reg.counter("mpit_ft_faults_total",
+                                      kind="drop", rank=self.rank)
+        self._m_duplicated = reg.counter("mpit_ft_faults_total",
+                                         kind="dup", rank=self.rank)
+        self._m_delayed = reg.counter("mpit_ft_faults_total",
+                                      kind="delay", rank=self.rank)
+
+    @property
+    def dropped(self) -> int:
+        return int(self._m_dropped.value)
+
+    @property
+    def duplicated(self) -> int:
+        return int(self._m_duplicated.value)
+
+    @property
+    def delayed(self) -> int:
+        return int(self._m_delayed.value)
 
     # -- send-side fault application ----------------------------------------
 
@@ -153,26 +173,26 @@ class FaultyTransport(Transport):
         total = self._sent_to.get(dst, 0) + 1
         self._sent_to[dst] = total
         if dst in self.severed:
-            self.dropped += 1
+            self._m_dropped.inc()
             return Handle(kind="send", peer=dst, tag=tag, meta={"ft": DROP})
         if self.plan.sever_after >= 0 and total > self.plan.sever_after:
             self.severed.add(dst)
-            self.dropped += 1
+            self._m_dropped.inc()
             return Handle(kind="send", peer=dst, tag=tag, meta={"ft": DROP})
         n = self._counts.get((dst, tag), 0) + 1
         self._counts[(dst, tag)] = n
         verdict = self.plan.decide(self.rank, dst, tag, n)
         if verdict == DROP:
-            self.dropped += 1
+            self._m_dropped.inc()
             return Handle(kind="send", peer=dst, tag=tag, meta={"ft": DROP})
         if verdict == DUP:
-            self.duplicated += 1
+            self._m_duplicated.inc()
             inner = [self.inner.isend(data, dst, tag),
                      self.inner.isend(data, dst, tag)]
             return Handle(kind="send", peer=dst, tag=tag,
                           meta={"ft": DUP, "inner": inner})
         if verdict == DELAY:
-            self.delayed += 1
+            self._m_delayed.inc()
             return Handle(
                 kind="send", peer=dst, tag=tag, buf=data,
                 meta={"ft": DELAY, "polls": self.plan.delay_polls},

@@ -1,0 +1,74 @@
+"""mpit_tpu.obs — unified observability: metrics, op spans, tracing.
+
+The reference framework's only instrumentation is ad-hoc wall-clock
+tables (``tm.feval``/``tm.sync`` in the MNIST trainer, an 11-bucket
+table in BiCNN), and the async-PS literature is unambiguous that the
+pathologies that matter at scale — stragglers, skewed arrival, retry
+storms (MXNET-MPI arxiv 1801.03855, the imbalanced-arrival study arxiv
+1804.05349) — are diagnosable only with per-op timing and per-peer
+counters.  This package is the one place the stack reports through:
+
+- :mod:`mpit_tpu.obs.metrics` — a process-local **registry** of
+  counters, gauges and fixed-log2-bucket histograms.  Zero-dep,
+  lock-cheap, snapshot-to-dict plus Prometheus-style text exposition.
+  Disabled (the default) it is a **no-op object**: every instrument is
+  one shared null singleton whose methods do nothing — hot paths pay a
+  method call, never a branch tree or a clock read.
+- :mod:`mpit_tpu.obs.spans` — **op spans**: every PS op records
+  start/end, per-phase marks (encode → send → ack on the client,
+  apply → ack on the server), its ``[epoch, seq]`` identity and an
+  outcome, so a straggling or retried op is attributable to a phase
+  and a peer.  Scheduler task lifecycles record alongside.
+- :mod:`mpit_tpu.obs.trace` — a **Chrome trace-event exporter**: spans
+  plus task lifecycles dump as trace JSON (one pid per rank, one tid
+  per op channel / task), merged across ranks by the gang launcher at
+  exit (``MPIT_OBS_TRACE=path``) and viewable in Perfetto /
+  chrome://tracing next to a ``jax.profiler`` device timeline.
+- :mod:`mpit_tpu.obs.timers` — the old ``utils/timers.py``
+  (``PhaseTimers``, ``trace_annotation``, ``profiler_trace``), folded
+  in; ``mpit_tpu.utils.timers`` re-exports for back-compat.
+
+Enablement: ``MPIT_OBS=1`` (or ``MPIT_OBS_TRACE=<path>``, which implies
+it) turns the global registry + recorder on; :func:`configure` does the
+same programmatically for tests.  Components capture the registry at
+construction, so enable *before* building transports/roles.  See
+docs/OBSERVABILITY.md for the metric catalog and trace schema.
+"""
+
+from mpit_tpu.obs.metrics import (
+    NULL_REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    NullRegistry,
+    Registry,
+    configure,
+    get_registry,
+    obs_enabled,
+    registry_or_local,
+)
+from mpit_tpu.obs.spans import (
+    NULL_RECORDER,
+    NULL_SPAN,
+    OpSpan,
+    SpanRecorder,
+    get_recorder,
+)
+from mpit_tpu.obs.timers import PhaseTimers, profiler_trace, trace_annotation
+from mpit_tpu.obs.trace import (
+    maybe_merge_rank_traces,
+    maybe_write_rank_trace,
+    merge_traces,
+    validate_trace,
+    write_rank_trace,
+)
+
+__all__ = [
+    "Registry", "NullRegistry", "NULL_REGISTRY",
+    "Counter", "Gauge", "Histogram",
+    "get_registry", "registry_or_local", "obs_enabled", "configure",
+    "SpanRecorder", "OpSpan", "NULL_RECORDER", "NULL_SPAN", "get_recorder",
+    "write_rank_trace", "merge_traces", "validate_trace",
+    "maybe_write_rank_trace", "maybe_merge_rank_traces",
+    "PhaseTimers", "trace_annotation", "profiler_trace",
+]

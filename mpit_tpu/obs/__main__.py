@@ -1,0 +1,9 @@
+"""``python -m mpit_tpu.obs <trace.json>...`` — validate Chrome traces
+(the warning-free spelling of ``python -m mpit_tpu.obs.trace``, which
+runpy grumbles about because the package imports the submodule)."""
+
+import sys
+
+from mpit_tpu.obs.trace import main
+
+sys.exit(main())

@@ -1,0 +1,185 @@
+"""Op spans and task lifecycles — who spent how long in which phase.
+
+A counter says *how many* retries happened; a span says *which op*
+retried, against *which peer*, and where its time went.  Two record
+kinds:
+
+- **Op spans** (:class:`OpSpan`): one per PS op.  Created when the op
+  starts processing, phase-marked at each transition (client:
+  ``encode`` → ``send`` → ``ack``, with ``backoff``/``send``/``ack``
+  repeating per retry attempt; server: ``apply`` → ``ack``), annotated
+  with the op's wire identity (peer, ``[epoch, seq]``) and closed with
+  an outcome (``ok`` / ``applied`` / ``dup`` / ``stale`` / ``aborted``
+  / ``exhausted``).  Closing also feeds the ``mpit_ps_op_seconds``
+  histogram, so the metrics and the trace always agree.
+- **Task lifecycles**: the cooperative scheduler records each task's
+  spawn→completion window and terminal state — service loops, pumps,
+  and reapers show up as rows in the exported trace.
+
+The recorder owns every clock read.  Role files (``ps/``, ``ft/``,
+``comm/``) never call ``time.monotonic()`` to measure — the MT-O4xx
+lint family enforces it — so a disabled recorder (the default) means
+zero clock reads on the hot path: :data:`NULL_SPAN` and
+:data:`NULL_RECORDER` are shared do-nothing objects.
+
+Cross-process alignment: spans are recorded on the monotonic clock, and
+the recorder captures a wall-clock offset at construction; the trace
+exporter adds it so per-rank files merge onto one timeline (host NTP
+skew applies, which is fine at the phase granularity traced here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from mpit_tpu.obs import metrics as _metrics
+
+
+class NullSpan:
+    """Shared no-op span — the disabled path's op object."""
+
+    __slots__ = ()
+
+    def mark(self, phase: str) -> None:
+        pass
+
+    def note(self, **kw) -> None:
+        pass
+
+    def end(self, outcome: str = "ok", **kw) -> None:
+        pass
+
+
+NULL_SPAN = NullSpan()
+
+
+class OpSpan:
+    __slots__ = ("_rec", "name", "tid", "t0", "t1", "marks", "args",
+                 "outcome")
+
+    def __init__(self, rec: "SpanRecorder", name: str, tid: str,
+                 args: Dict[str, object]):
+        self._rec = rec
+        self.name = name
+        self.tid = tid
+        self.t0 = time.monotonic()
+        self.t1: Optional[float] = None
+        self.marks: List[Tuple[str, float]] = []
+        self.args = args
+        self.outcome = ""
+
+    def mark(self, phase: str) -> None:
+        """Phase ``phase`` begins now (it runs until the next mark or
+        the end of the span)."""
+        self.marks.append((phase, time.monotonic()))
+
+    def note(self, **kw) -> None:
+        """Attach args discovered mid-op (e.g. seq assigned after the
+        encode, retry counts)."""
+        self.args.update(kw)
+
+    def end(self, outcome: str = "ok", **kw) -> None:
+        if self.t1 is not None:
+            return  # idempotent: error paths may end defensively
+        self.t1 = time.monotonic()
+        self.outcome = outcome
+        if kw:
+            self.args.update(kw)
+        self._rec._finish(self)
+
+
+class SpanRecorder:
+    """Process-local span sink (one per process; role threads share it —
+    appends are GIL-atomic and records are immutable once finished)."""
+
+    enabled = True
+
+    def __init__(self, registry=None):
+        self.registry = registry if registry is not None \
+            else _metrics.get_registry()
+        self.spans: List[OpSpan] = []
+        self.tasks: List[Tuple[str, float, float, str]] = []
+        #: monotonic -> wall offset for cross-rank trace merging
+        self.epoch_offset = time.time() - time.monotonic()
+        self._hist_lock = threading.Lock()
+        self._hists: Dict[Tuple[str, str], object] = {}
+
+    def op(self, name: str, peer: object = "?", side: str = "client",
+           **args) -> OpSpan:
+        """Begin an op span.  ``tid`` groups ops into trace rows — one
+        per (side, peer, tag) channel, which the protocol already keeps
+        strictly sequential (client pump FIFO, per-channel server
+        loops), so begin/end events nest cleanly."""
+        args["peer"] = peer
+        args["side"] = side
+        return OpSpan(self, name, f"{side}:{peer}:{name}", args)
+
+    def _finish(self, span: OpSpan) -> None:
+        self.spans.append(span)
+        key = (span.name, str(span.args.get("side", "")))
+        hist = self._hists.get(key)
+        if hist is None:
+            with self._hist_lock:
+                hist = self._hists.get(key)
+                if hist is None:
+                    hist = self.registry.histogram(
+                        "mpit_ps_op_seconds", op=key[0], side=key[1])
+                    self._hists[key] = hist
+        hist.observe(span.t1 - span.t0)
+
+    # -- task lifecycles (driven by aio.Scheduler) ---------------------------
+
+    def task_begin(self, name: str) -> float:
+        return time.monotonic()
+
+    def task_end(self, token: Optional[float], name: str, state: str) -> None:
+        if token is None:
+            return  # task spawned while recording was disabled
+        self.tasks.append((name, token, time.monotonic(), state))
+
+
+class NullRecorder:
+    """The disabled recorder: hands out :data:`NULL_SPAN`, records
+    nothing, reads no clock."""
+
+    enabled = False
+    spans: tuple = ()
+    tasks: tuple = ()
+    epoch_offset = 0.0
+
+    def op(self, name: str, peer: object = "?", side: str = "client",
+           **args) -> NullSpan:
+        return NULL_SPAN
+
+    def task_begin(self, name: str) -> None:
+        return None
+
+    def task_end(self, token, name: str, state: str) -> None:
+        pass
+
+
+NULL_RECORDER = NullRecorder()
+
+_GLOBAL: Optional[SpanRecorder] = None
+_LOCK = threading.Lock()
+
+
+def get_recorder():
+    """The process-global recorder when obs is enabled, else the null
+    recorder.  Same capture-at-construction contract as the registry."""
+    if not _metrics.obs_enabled():
+        return NULL_RECORDER
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = SpanRecorder()
+    return _GLOBAL
+
+
+def reset() -> None:
+    """Drop the global recorder (tests; called by obs.configure)."""
+    global _GLOBAL
+    _GLOBAL = None

@@ -76,6 +76,7 @@ from mpit_tpu.ft import (
     pack_header,
     unpack_header,
 )
+from mpit_tpu.obs import NULL_SPAN, get_recorder, registry_or_local
 from mpit_tpu.ps import tags
 from mpit_tpu.ps.sharding import Shard, shard_layout
 from mpit_tpu.utils.logging import get_logger
@@ -118,8 +119,19 @@ class ParamClient:
         self._seq: Dict[Tuple[int, int], int] = {}
         self._hb_last = 0.0
         self._hb_seq = 0
-        self.retries = 0  # resends performed (observability/test hook)
-        self.heartbeats_sent = 0
+        # Observability (mpit_tpu.obs): protocol counters live in a real
+        # registry always (they are load-bearing results — the global
+        # one when obs is enabled, a private one otherwise), and every
+        # PS op records a span through the recorder (the null recorder
+        # when disabled — no clock reads, no allocation).
+        self.metrics = registry_or_local()
+        self._spans = get_recorder()
+        self._m_retries = self.metrics.counter(
+            "mpit_ft_retries_total", rank=rank)
+        self._m_backoff = self.metrics.counter(
+            "mpit_ft_backoff_seconds_total", rank=rank)
+        self._m_hb = self.metrics.counter(
+            "mpit_ft_heartbeats_sent_total", rank=rank)
         # Per-server FIFO op chains: ops addressed to the same server run in
         # issue order (a send_grad's ack completes before a later param
         # request is sent), while different servers stay fully concurrent.
@@ -203,6 +215,17 @@ class ParamClient:
             raise ValueError("reset buffers must keep the registered length")
         self._register(param, grad)
 
+    # -- observability back-compat reads ------------------------------------
+
+    @property
+    def retries(self) -> int:
+        """Resends performed (registry-backed; observability/test hook)."""
+        return int(self._m_retries.value)
+
+    @property
+    def heartbeats_sent(self) -> int:
+        return int(self._m_hb.value)
+
     # -- FT plumbing ---------------------------------------------------------
 
     def _op_deadline(self) -> Optional[float]:
@@ -215,27 +238,37 @@ class ParamClient:
         return seq
 
     def _op_with_retry(self, srank: int, payload: np.ndarray, tag: int,
-                       ack_tag: int, seq: int, what: str):
+                       ack_tag: int, seq: int, what: str, span=NULL_SPAN):
         """Send the staged frame, await its seq-matched ack; resend the
         same bytes on deadline under the backoff policy.  Exhaustion
-        raises :class:`RetryExhausted` — the never-hang guarantee."""
+        raises :class:`RetryExhausted` — the never-hang guarantee.
+        ``span`` (an obs op span) gets per-attempt phase marks and the
+        terminal outcome, so a retried op is attributable in the trace."""
         last: Optional[BaseException] = None
         for attempt in range(self._retry.attempts):
             if attempt:
-                self.retries += 1
+                backoff = self._retry.backoff_s(attempt)
+                self._m_retries.inc()
+                self._m_backoff.inc(backoff)
+                span.mark("backoff")
+                span.note(retries=attempt)
                 self.log.debug("%s: retry %d after %r", what, attempt, last)
-                if not (yield from aio_sleep(
-                        self._retry.backoff_s(attempt), live=self.live)):
+                if not (yield from aio_sleep(backoff, live=self.live)):
+                    span.end("aborted")
                     return None
             deadline = self._op_deadline()
             try:
+                span.mark("send")
                 yield from aio_send(self.transport, payload, srank, tag,
                                     live=self.live, deadline=deadline)
+                span.mark("ack")
                 got = yield from self._await_ack(srank, ack_tag, seq, deadline)
                 if got is not None or not self.live.io:
+                    span.end("ok" if got is not None else "aborted")
                     return got
             except DeadlineExceeded as exc:
                 last = exc
+        span.end("exhausted")
         raise RetryExhausted(what, self._retry.attempts, last)
 
     def _await_ack(self, srank: int, ack_tag: int, seq: int,
@@ -277,7 +310,7 @@ class ParamClient:
         self._hb_last = now
         self._hb_seq += 1
         payload = header_frame(self.ft.epoch, self._hb_seq)
-        self.heartbeats_sent += 1
+        self._m_hb.inc()
         for srank in self.sranks:
             self.sched.spawn(
                 self._hb_send(payload, srank), name=f"heartbeat:{srank}"
@@ -300,20 +333,26 @@ class ParamClient:
         the per-server staging frame at ship time; the int8 residual is
         folded in and refreshed by the same pass.  Framed mode stamps
         [epoch, seq] and retries the staged bytes on deadline."""
+        span = self._spans.op("GRAD", peer=srank, side="client")
         view = self.grad[shard.offset : shard.end]
         wire = self._grad_wire.get(srank)
+        span.mark("encode")
         payload = self._encode(view, wire, residual=self._residual.get(srank))
         if not self.ft.framed:
+            span.mark("send")
             yield from aio_send(self.transport, payload, srank, tags.GRAD,
                                 live=self.live, deadline=self._op_deadline())
+            span.mark("ack")
             yield from aio_recv(self.transport, srank, tags.GRAD_ACK,
                                 live=self.live, deadline=self._op_deadline())
+            span.end("ok")
             return
         seq = self._next_seq(srank, tags.GRAD)
+        span.note(epoch=self.ft.epoch, seq=seq)
         pack_header(payload, self.ft.epoch, seq)
         yield from self._op_with_retry(
             srank, payload, tags.GRAD, tags.GRAD_ACK, seq,
-            f"GRAD to server {srank}",
+            f"GRAD to server {srank}", span=span,
         )
 
     def _recv_param(self, srank: int, shard: Shard):
@@ -321,48 +360,64 @@ class ParamClient:
         (reference pclient.lua:72-82) — via the wire staging frame when
         the codec is not identity.  Framed mode seq-tags the request and
         discards snapshot frames that echo an earlier request."""
+        span = self._spans.op("PARAM", peer=srank, side="client")
         out = self.param[shard.offset : shard.end]
         wire = self._param_wire.get(srank)
         if not self.ft.framed:
+            span.mark("send")
             yield from aio_send(self.transport, tags.EMPTY, srank,
                                 tags.PARAM_REQ, live=self.live,
                                 deadline=self._op_deadline())
+            span.mark("recv")
             got = yield from aio_recv(
                 self.transport, srank, tags.PARAM, live=self.live,
                 out=out if wire is None else wire,
                 deadline=self._op_deadline(),
             )
             if got is not None and wire is not None:
+                span.mark("decode")
                 self.codec.decode_into(wire, out)
+            span.end("ok" if got is not None else "aborted")
             return
         seq = self._next_seq(srank, tags.PARAM_REQ)
+        span.note(epoch=self.ft.epoch, seq=seq)
         req = header_frame(self.ft.epoch, seq)
         last: Optional[BaseException] = None
         for attempt in range(self._retry.attempts):
             if attempt:
-                self.retries += 1
-                if not (yield from aio_sleep(
-                        self._retry.backoff_s(attempt), live=self.live)):
+                backoff = self._retry.backoff_s(attempt)
+                self._m_retries.inc()
+                self._m_backoff.inc(backoff)
+                span.mark("backoff")
+                span.note(retries=attempt)
+                if not (yield from aio_sleep(backoff, live=self.live)):
+                    span.end("aborted")
                     return
             deadline = self._op_deadline()
             try:
+                span.mark("send")
                 yield from aio_send(self.transport, req, srank,
                                     tags.PARAM_REQ, live=self.live,
                                     deadline=deadline)
+                span.mark("recv")
                 while True:
                     got = yield from aio_recv(
                         self.transport, srank, tags.PARAM, live=self.live,
                         out=wire, deadline=deadline,
                     )
                     if got is None:
+                        span.end("aborted")
                         return
                     epoch, aseq = unpack_header(wire)
                     if epoch == self.ft.epoch and aseq == seq:
+                        span.mark("decode")
                         self._decode_framed(wire, out)
+                        span.end("ok")
                         return
                     # stale snapshot (earlier request's duplicate): drop
             except DeadlineExceeded as exc:
                 last = exc
+        span.end("exhausted")
         raise RetryExhausted(
             f"PARAM read from server {srank}", self._retry.attempts, last)
 
@@ -370,21 +425,27 @@ class ParamClient:
         """Whole-shard write, await ack (reference pclient.lua:60-70).
         No residual: parameter pushes (seeding / single-worker mirror)
         are one-shot state transfers, not an accumulating signal."""
+        span = self._spans.op("PARAM_PUSH", peer=srank, side="client")
         view = self.param[shard.offset : shard.end]
         wire = self._param_wire.get(srank)
+        span.mark("encode")
         payload = self._encode(view, wire)
         if not self.ft.framed:
+            span.mark("send")
             yield from aio_send(self.transport, payload, srank,
                                 tags.PARAM_PUSH, live=self.live,
                                 deadline=self._op_deadline())
+            span.mark("ack")
             yield from aio_recv(self.transport, srank, tags.PARAM_PUSH_ACK,
                                 live=self.live, deadline=self._op_deadline())
+            span.end("ok")
             return
         seq = self._next_seq(srank, tags.PARAM_PUSH)
+        span.note(epoch=self.ft.epoch, seq=seq)
         pack_header(payload, self.ft.epoch, seq)
         yield from self._op_with_retry(
             srank, payload, tags.PARAM_PUSH, tags.PARAM_PUSH_ACK, seq,
-            f"PARAM_PUSH to server {srank}",
+            f"PARAM_PUSH to server {srank}", span=span,
         )
 
     def _encode(self, view: np.ndarray, wire: Optional[np.ndarray],

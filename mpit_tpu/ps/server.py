@@ -80,6 +80,7 @@ from mpit_tpu.ft import (
     LeaseRegistry,
     unpack_header,
 )
+from mpit_tpu.obs import get_recorder, registry_or_local
 from mpit_tpu.optim.rules import ShardRule, make as make_rule
 from mpit_tpu.ps import tags
 from mpit_tpu.utils.logging import get_logger
@@ -149,10 +150,26 @@ class ParamServer:
         self._req_buf: Dict[int, np.ndarray] = {}
         self._hb_buf: Dict[int, np.ndarray] = {}
         self._restored_clients: set = set()
-        self.dup_ops = 0  # framed ops admitted as duplicates (re-acked)
-        self.stale_drops = 0  # stale-epoch frames dropped without ack
-        self.heartbeats_seen = 0
-        self.rejoins = 0
+        # Observability (mpit_tpu.obs): every protocol counter lives in
+        # a real registry (the global one when obs is enabled, a private
+        # one otherwise — they are load-bearing results either way) and
+        # the attribute names below stay readable as properties.  Op
+        # processing records spans through the recorder (the null
+        # recorder when obs is off: no clock reads).
+        self.metrics = registry_or_local()
+        self._spans = get_recorder()
+        _m, _r = self.metrics, rank
+        self._m_grads = _m.counter("mpit_ps_grads_applied_total", rank=_r)
+        self._m_served = _m.counter("mpit_ps_params_served_total", rank=_r)
+        self._m_dups = _m.counter("mpit_ps_dup_ops_total", rank=_r)
+        self._m_stale = _m.counter("mpit_ps_stale_drops_total", rank=_r)
+        self._m_hb_seen = _m.counter("mpit_ps_heartbeats_seen_total", rank=_r)
+        self._m_rejoins = _m.counter("mpit_ps_rejoins_total", rank=_r)
+        self._m_snap_copies = _m.counter(
+            "mpit_ps_snapshot_copies_total", rank=_r)
+        self._m_snap_hits = _m.counter("mpit_ps_snapshot_hits_total", rank=_r)
+        self._m_ckpts = _m.counter("mpit_ps_ckpts_written_total", rank=_r)
+        self._m_evictions = _m.counter("mpit_ft_evictions_total", rank=_r)
         # Version-counted snapshot cache: _snap_version bumps on every
         # committed write (grad apply / seed / restore); _snap_host is
         # the one device->host copy for that version and _snap_wire the
@@ -162,8 +179,6 @@ class ParamServer:
         self._snap_version = 0
         self._snap_host: Optional[Tuple[int, np.ndarray]] = None
         self._snap_wire: Dict[str, Tuple[int, np.ndarray]] = {}
-        self.snapshot_copies = 0  # device->host copies actually performed
-        self.snapshot_hits = 0  # PARAM serves satisfied from the cache
         if device not in ("cpu", "default"):
             raise ValueError(f"device must be 'cpu' or 'default', got {device!r}")
         self._device = None
@@ -183,13 +198,52 @@ class ParamServer:
         # Placement discipline: every jnp array this server creates is
         # built inside _dev_ctx(), so shard + optimizer state live (and
         # the jitted apply runs) on the configured backend.
-        self.grads_applied = 0
-        self.params_served = 0
         self._restored = False
         # Periodic shard checkpointing (the resume flow's producer side).
         self._ckpt_dir = str(ckpt_dir) if ckpt_dir else None
         self._ckpt_interval = float(ckpt_interval)
-        self.ckpts_written = 0
+
+    # -- registry-backed counter reads (the pre-obs attribute surface) -------
+
+    @property
+    def grads_applied(self) -> int:
+        return int(self._m_grads.value)
+
+    @grads_applied.setter
+    def grads_applied(self, v: int) -> None:
+        self._m_grads.value = int(v)  # checkpoint restore continuity
+
+    @property
+    def params_served(self) -> int:
+        return int(self._m_served.value)
+
+    @property
+    def dup_ops(self) -> int:
+        return int(self._m_dups.value)
+
+    @property
+    def stale_drops(self) -> int:
+        return int(self._m_stale.value)
+
+    @property
+    def heartbeats_seen(self) -> int:
+        return int(self._m_hb_seen.value)
+
+    @property
+    def rejoins(self) -> int:
+        return int(self._m_rejoins.value)
+
+    @property
+    def snapshot_copies(self) -> int:
+        return int(self._m_snap_copies.value)
+
+    @property
+    def snapshot_hits(self) -> int:
+        return int(self._m_snap_hits.value)
+
+    @property
+    def ckpts_written(self) -> int:
+        return int(self._m_ckpts.value)
 
     def _dev_ctx(self):
         """Context placing jnp array creation + jit execution on the
@@ -340,13 +394,13 @@ class ParamServer:
         version = self._snap_version
         cached = self._snap_wire.get(codec.name)
         if cached is not None and cached[0] == version:
-            self.snapshot_hits += 1
+            self._m_snap_hits.inc()
             return cached[1]
         if self._snap_host is None or self._snap_host[0] != version:
             # Serve-latest-committed: np.asarray snapshots the current
             # immutable device array (the one device->host copy).
             self._snap_host = (version, np.asarray(self.param))
-            self.snapshot_copies += 1
+            self._m_snap_copies.inc()
         host = self._snap_host[1]
         if codec.identity:
             wire = host
@@ -412,7 +466,7 @@ class ParamServer:
             self.leases.arm(crank, self.leases.epoch(crank),
                             heartbeats=self._hb.get(crank, False))
             self._alloc_client(crank, codec)
-            self.rejoins += 1
+            self._m_rejoins.inc()
             # Two generations must never recv one channel concurrently —
             # wait for the superseded loops to abort out.
             while self._svc_live[crank] > 0:
@@ -444,17 +498,22 @@ class ParamServer:
             if got is None:
                 return
             epoch = seq = 0
+            span = self._spans.op("PARAM_PUSH", peer=crank, side="server")
             if framed:
                 epoch, seq = unpack_header(staging)
+                span.note(epoch=epoch, seq=seq)
                 self.leases.renew(crank, epoch)
                 verdict = self.dedup.admit(crank, tags.PARAM_PUSH, epoch, seq)
                 if verdict == STALE:
-                    self.stale_drops += 1
+                    self._m_stale.inc()
+                    span.end("stale")
                     continue
                 if verdict == DUP:
-                    self.dup_ops += 1
+                    self._m_dups.inc()
+                    span.mark("ack")
                     yield from self._send_ack(
                         crank, tags.PARAM_PUSH_ACK, epoch, seq, gen)
+                    span.end("dup")
                     continue
             if warn_unexpected:
                 self.log.warning(
@@ -462,6 +521,7 @@ class ParamServer:
                     "params overwritten (optimizer state kept) — start "
                     "resume clients with seed_servers=False", crank,
                 )
+            span.mark("apply")
             if codec.identity and not hdr:
                 host = staging
             elif codec.identity:
@@ -472,6 +532,7 @@ class ParamServer:
             with self._dev_ctx():
                 self.param = jnp.asarray(host)
             self._committed()
+            span.mark("ack")
             if framed:
                 yield from self._send_ack(
                     crank, tags.PARAM_PUSH_ACK, epoch, seq, gen)
@@ -480,6 +541,7 @@ class ParamServer:
                     self.transport, tags.EMPTY, crank, tags.PARAM_PUSH_ACK,
                     live=self.live, abort=self._svc_abort(crank, gen),
                 )
+            span.end("applied")
             if once:
                 return
 
@@ -503,19 +565,26 @@ class ParamServer:
                 return
             if not self.live.io:
                 continue
+            span = self._spans.op("PARAM", peer=crank, side="server")
             if not framed:
+                span.mark("snapshot")
                 snapshot = self._snapshot_wire(codec)
+                span.mark("send")
                 yield from aio_send(
                     self.transport, snapshot, crank, tags.PARAM,
                     live=self.live, abort=self._svc_abort(crank, gen),
                 )
-                self.params_served += 1
+                self._m_served.inc()
+                span.end("served")
                 continue
             epoch, seq = int(req[0]), int(req[1])
+            span.note(epoch=epoch, seq=seq)
             if epoch < self.leases.epoch(crank):
-                self.stale_drops += 1  # dead incarnation's request
+                self._m_stale.inc()  # dead incarnation's request
+                span.end("stale")
                 continue
             self.leases.renew(crank, epoch)
+            span.mark("snapshot")
             wire = self._snapshot_wire(codec)
             wire_u8 = wire.view(np.uint8) if wire.dtype != np.uint8 else wire
             reply = self._param_send.get(crank)
@@ -524,11 +593,13 @@ class ParamServer:
                 self._param_send[crank] = reply
             reply[:HDR_BYTES].view(np.int64)[:] = (epoch, seq)
             reply[HDR_BYTES:] = wire_u8
+            span.mark("send")
             yield from aio_send(
                 self.transport, reply, crank, tags.PARAM, live=self.live,
                 abort=self._svc_abort(crank, gen),
             )
-            self.params_served += 1
+            self._m_served.inc()
+            span.end("served")
 
     def _recv_grad(self, crank: int, gen: int = 0):
         """Loop: receive gradient frame, decode+apply the shard rule in
@@ -552,18 +623,24 @@ class ParamServer:
             if got is None:
                 return
             epoch = seq = 0
+            span = self._spans.op("GRAD", peer=crank, side="server")
             if framed:
                 epoch, seq = unpack_header(gbuf)
+                span.note(epoch=epoch, seq=seq)
                 self.leases.renew(crank, epoch)
                 verdict = self.dedup.admit(crank, tags.GRAD, epoch, seq)
                 if verdict == STALE:
-                    self.stale_drops += 1
+                    self._m_stale.inc()
+                    span.end("stale")
                     continue
                 if verdict == DUP:
-                    self.dup_ops += 1
+                    self._m_dups.inc()
+                    span.mark("ack")
                     yield from self._send_ack(crank, tags.GRAD_ACK,
                                               epoch, seq, gen)
+                    span.end("dup")
                     continue
+            span.mark("apply")
             with self._dev_ctx():
                 if parts is None:
                     grad_in: Any = jnp.asarray(data if data is not None else gbuf)
@@ -572,10 +649,12 @@ class ParamServer:
                 self.param, self.rule_state = apply_fn(
                     self.param, grad_in, self.rule_state
                 )
-            self.grads_applied += 1
+            self._m_grads.inc()
             self._committed()
             if not self.live.on:
+                span.end("aborted")
                 continue
+            span.mark("ack")
             if framed:
                 yield from self._send_ack(crank, tags.GRAD_ACK, epoch, seq, gen)
             else:
@@ -583,6 +662,7 @@ class ParamServer:
                     self.transport, tags.EMPTY, crank, tags.GRAD_ACK,
                     live=self.live, abort=self._svc_abort(crank, gen),
                 )
+            span.end("applied")
 
     def _recv_heartbeat(self, crank: int, gen: int = 0):
         """Loop: consume HEARTBEAT beacons, renew the client's lease
@@ -598,7 +678,7 @@ class ParamServer:
             )
             if got is None:
                 return
-            self.heartbeats_seen += 1
+            self._m_hb_seen.inc()
             self.leases.renew(crank, int(buf[0]))
 
     def _recv_stop(self, crank: int, gen: int = 0):
@@ -630,6 +710,7 @@ class ParamServer:
                     crank, self.ft.lease_ttl_s,
                 )
                 self.leases.evict(crank)
+                self._m_evictions.inc()
                 self._gen[crank] += 1  # stale loops abort at next poll
                 self._release_client(crank)
             if self.leases.all_done():
@@ -670,7 +751,7 @@ class ParamServer:
         else:
             host = np.asarray(self.param)
             self._snap_host = (self._snap_version, host)
-            self.snapshot_copies += 1
+            self._m_snap_copies.inc()
         return str(save_server_state(
             directory, self.rank, self.offset, self.size,
             host,
@@ -731,11 +812,11 @@ class ParamServer:
             self.sched.ping_pass()
             if time.monotonic() >= next_save:
                 self.save_state(self._ckpt_dir)
-                self.ckpts_written += 1
+                self._m_ckpts.inc()
                 next_save = time.monotonic() + self._ckpt_interval
         if self.param is not None:
             self.save_state(self._ckpt_dir)  # final state at stop
-            self.ckpts_written += 1
+            self._m_ckpts.inc()
         if self.sched.errors:
             raise self.sched.errors.pop(0)
 
@@ -810,17 +891,8 @@ class ParamServer:
             self._serve_with_checkpoints()
         else:
             self.sched.wait()
-        self.log.debug(
-            "stopped: %d grads applied (%d dups re-acked, %d stale drops), "
-            "%d params served (%d snapshot copies, %d cache hits), "
-            "%d heartbeats, %d evictions, %d rejoins",
-            self.grads_applied,
-            self.dup_ops,
-            self.stale_drops,
-            self.params_served,
-            self.snapshot_copies,
-            self.snapshot_hits,
-            self.heartbeats_seen,
-            self.leases.evictions,
-            self.rejoins,
-        )
+        # End-of-run summary rendered straight from the registry — every
+        # number here (and any new instrument a layer adds) shows up
+        # without touching this line.
+        self.log.debug("stopped: %s",
+                       self.metrics.format_summary(prefix="mpit_"))

@@ -50,7 +50,7 @@ from mpit_tpu.optim.msgd import MSGDConfig
 from mpit_tpu.utils.checkpoint import load_flat, save_flat
 from mpit_tpu.utils.config import Config
 from mpit_tpu.utils.logging import get_logger
-from mpit_tpu.utils.timers import PhaseTimers
+from mpit_tpu.obs import PhaseTimers
 
 # The full plaunch.lua flag surface (reference BiCNN/plaunch.lua:7-69),
 # snake_cased; rebuild-only knobs at the bottom.

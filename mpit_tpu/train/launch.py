@@ -223,6 +223,11 @@ def _child_main() -> None:
     transport = child_transport(cfg, rank, size)
     result = run_rank(rank, size, cfg, transport)
     transport.close()
+    # Per-rank Chrome-trace part (MPIT_OBS_TRACE; no-op when unset) —
+    # the gang parent merges the parts into one timeline at exit.
+    from mpit_tpu.obs import maybe_write_rank_trace
+
+    maybe_write_rank_trace(rank, role=str(result.get("role", "")))
     import jax
 
     result.setdefault("platform", jax.default_backend())
@@ -297,6 +302,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     t0 = time.monotonic()
     if int(cfg.np) == 1:
         result = run_rank(0, 1, cfg, transport=None)
+        from mpit_tpu.obs import maybe_merge_rank_traces, maybe_write_rank_trace
+
+        maybe_write_rank_trace(0, role=str(result.get("role", "")))
+        maybe_merge_rank_traces()
         print(json.dumps({"rank0": _summarize(result)}, indent=2))
     else:
         results = launch_processes(cfg)

@@ -31,7 +31,7 @@ from typing import List, Optional
 
 from mpit_tpu.utils.config import Config
 from mpit_tpu.utils.logging import get_logger
-from mpit_tpu.utils.timers import profiler_trace
+from mpit_tpu.obs import profiler_trace
 
 MESH_LAUNCH_DEFAULTS = Config(
     model="cnn",  # linear | mlp | cnn

@@ -29,7 +29,7 @@ from mpit_tpu.optim import EAMSGD, MSGD, Downpour, RuleShell, SingleWorker
 from mpit_tpu.optim.msgd import MSGDConfig
 from mpit_tpu.utils.config import Config
 from mpit_tpu.utils.logging import get_logger
-from mpit_tpu.utils.timers import PhaseTimers, profiler_trace
+from mpit_tpu.obs import PhaseTimers, profiler_trace
 
 TRAINER_DEFAULTS = Config(
     model="linear",  # linear | mlp | cnn

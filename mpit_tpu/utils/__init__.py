@@ -1,6 +1,7 @@
-"""Shared utilities: typed config, phase timers, logging, serialization."""
+"""Shared utilities: typed config, logging, serialization.  The phase
+timers live in :mod:`mpit_tpu.obs` now; re-exported here for back-compat."""
 
+from mpit_tpu.obs.timers import PhaseTimers, profiler_trace, trace_annotation
 from mpit_tpu.utils.config import Config
-from mpit_tpu.utils.timers import PhaseTimers, profiler_trace, trace_annotation
 
 __all__ = ["Config", "PhaseTimers", "profiler_trace", "trace_annotation"]

@@ -20,3 +20,14 @@ def serve_req(transport):
 def drain(transport):
     # MT-P202: blocking transport convenience — unbounded busy-wait.
     return transport.recv(1, tags.GRAD)
+
+
+def timing_report():
+    import time
+
+    tw = time.time()  # MT-O401: wall clock read in a role file
+    t0 = time.monotonic()
+    work = sum(range(1000))
+    elapsed = time.monotonic() - t0  # MT-O401: hand-rolled elapsed timing
+    print("served in", elapsed, work, tw)  # MT-O402: print() reporting
+    return elapsed

@@ -101,6 +101,18 @@ class TestSeededViolations:
         locs = {(f.path, f.line) for f in bad.get("MT-J303", [])}
         assert ("hotpath.py", 19) in locs
 
+    def test_raw_timing_detected(self, bad):
+        # MT-O401: the seeded wall-clock read and the monotonic elapsed
+        # subtraction in timing_report — deadline arithmetic elsewhere in
+        # the fixtures (additions/comparisons) must not fire.
+        locs = {(f.path, f.line) for f in bad.get("MT-O401", [])}
+        assert locs == {("server.py", 28), ("server.py", 31)}
+
+    def test_print_reporting_detected(self, bad):
+        hits = bad.get("MT-O402", [])
+        assert [(f.path, f.line) for f in hits] == [("server.py", 32)]
+        assert "registry snapshot" in hits[0].message
+
 
 def test_clean_fixture_is_silent():
     assert _findings(CLEANPKG) == []

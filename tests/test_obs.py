@@ -1,0 +1,511 @@
+"""mpit_tpu.obs — metrics registry, op spans, Chrome-trace export.
+
+Three layers of assertion:
+
+1. the registry/recorder primitives (bucketing math, exposition format,
+   the null-object disabled path — including a microbenchmark proving
+   "disabled" really is a no-op object, not a branch tree);
+2. deterministic counters: under a seeded every-k fault plan the
+   retry/dedup/drop counters on both ends must match the arithmetic of
+   the plan *exactly* (computed by replaying ``FaultPlan.decide``, not
+   eyeballed), and a trace export round-trips through the validator;
+3. attribution: a dropped-then-retried op is findable in the exported
+   trace with its [epoch, seq] identity and retry count.
+
+Obs global state is process-wide, so every test that enables it goes
+through the ``obs_on`` fixture (enable + reset, restore after).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu import obs
+from mpit_tpu.aio import Scheduler, aio_sleep
+from mpit_tpu.comm.local import LocalRouter
+from mpit_tpu.ft import FaultPlan, FaultyTransport, FTConfig
+from mpit_tpu.obs import metrics as obs_metrics
+from mpit_tpu.obs import spans as obs_spans
+from mpit_tpu.obs import trace as obs_trace
+from mpit_tpu.ps import ParamClient, ParamServer, tags
+
+DATA_TAGS = frozenset({tags.GRAD, tags.PARAM_REQ, tags.PARAM_PUSH})
+
+#: fast retry posture for LocalRouter-speed gangs (mirrors test_ft.py)
+FAST_FT = FTConfig(op_deadline_s=0.25, max_retries=8,
+                   backoff_base_s=0.005, backoff_cap_s=0.02)
+
+
+@pytest.fixture
+def obs_on():
+    obs.configure(enabled=True, reset=True)
+    try:
+        yield obs.get_registry()
+    finally:
+        obs.configure(enabled=None, reset=True)
+
+
+def join_all(threads, timeout=30):
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "role thread did not stop (hang)"
+
+
+# ---------------------------------------------------------------------------
+# registry primitives
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = obs_metrics.Registry()
+        c = reg.counter("mpit_x_total", rank=1)
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        assert reg.counter("mpit_x_total", rank=1) is c  # get-or-create
+        assert reg.counter("mpit_x_total", rank=2) is not c
+        g = reg.gauge("mpit_depth")
+        g.set(7)
+        g.add(-2)
+        assert g.value == 5
+        h = reg.histogram("mpit_h_seconds")
+        for v in (0.75, 1.5, 3.0):
+            h.observe(v)
+        assert h.count == 3 and h.vmax == 3.0 and h.vmin == 0.75
+
+    def test_log2_bucketing_is_exact(self):
+        # [2^(e-1), 2^e) lands in the bucket whose key is e.
+        assert obs_metrics.bucket_index(0.75) == \
+            0 - obs_metrics.HIST_LO_EXP  # (0.5, 1.0) -> exponent 0
+        assert obs_metrics.bucket_index(1.0) == 1 - obs_metrics.HIST_LO_EXP
+        assert obs_metrics.bucket_index(0.0) == 0
+        assert obs_metrics.bucket_index(-5.0) == 0
+        assert obs_metrics.bucket_index(float(2 ** 40)) == \
+            obs_metrics.HIST_BUCKETS - 1  # clamped top
+        h = obs_metrics.Histogram("h")
+        h.observe(0.75)
+        snap = h.snapshot()
+        assert snap["buckets"] == {0: 1}
+
+    def test_kind_collision_fails_loudly(self):
+        reg = obs_metrics.Registry()
+        reg.counter("mpit_k")
+        with pytest.raises(TypeError, match="already registered"):
+            reg.histogram("mpit_k")
+
+    def test_snapshot_and_exposition(self):
+        reg = obs_metrics.Registry()
+        reg.counter("mpit_c_total", peer=3).inc(2)
+        reg.histogram("mpit_h").observe(1.5)
+        snap = reg.snapshot()
+        assert snap['mpit_c_total{peer="3"}'] == 2
+        assert snap["mpit_h"]["count"] == 1
+        text = reg.exposition()
+        assert 'mpit_c_total{peer="3"} 2' in text
+        assert "mpit_h_count 1" in text
+        assert 'le="+Inf"' in text
+        assert "mpit_c_total" in reg.format_summary(prefix="mpit_c")
+        assert "mpit_h" not in reg.format_summary(prefix="mpit_c")
+
+    def test_timer_context_observes(self):
+        reg = obs_metrics.Registry()
+        with reg.timer("mpit_t_seconds", codec="int8"):
+            pass
+        h = reg.histogram("mpit_t_seconds", codec="int8")
+        assert h.count == 1 and h.total >= 0.0
+
+    def test_counter_incs_are_thread_safe_enough(self):
+        reg = obs_metrics.Registry()
+        c = reg.counter("mpit_mt_total")
+        threads = [threading.Thread(
+            target=lambda: [c.inc() for _ in range(10000)])
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        join_all(threads)
+        assert c.value == 40000
+
+
+class TestDisabledPath:
+    def test_disabled_registry_is_the_null_object(self):
+        assert not obs.obs_enabled()
+        reg = obs.get_registry()
+        assert reg is obs_metrics.NULL_REGISTRY
+        assert reg.counter("x") is obs_metrics.NULL
+        assert reg.histogram("y", a=1) is obs_metrics.NULL
+        assert reg.timer("z") is obs_metrics.NULL
+        rec = obs_spans.get_recorder()
+        assert rec is obs_spans.NULL_RECORDER
+        assert rec.op("GRAD", peer=1) is obs_spans.NULL_SPAN
+        assert rec.task_begin("t") is None
+        # nothing accumulates anywhere
+        obs_metrics.NULL.inc(10)
+        obs_metrics.NULL.observe(1.0)
+        assert obs_metrics.NULL.value == 0
+        assert reg.snapshot() == {} and reg.exposition() == ""
+
+    def test_disabled_path_microbenchmark(self):
+        """The no-op-object claim, measured: 200k disabled counter incs
+        plus 20k disabled op-span lifecycles must finish far inside a
+        generous absolute budget (>= 5 µs/op would still pass — real
+        cost is tens of ns).  Catches anyone replacing the null object
+        with env reads or clock calls per operation."""
+        reg = obs.get_registry()
+        c = reg.counter("mpit_bench_total")
+        rec = obs_spans.get_recorder()
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            c.inc()
+        for _ in range(20_000):
+            sp = rec.op("GRAD", peer=1, side="client")
+            sp.mark("encode")
+            sp.end("ok")
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 1.1, (
+            f"disabled-path overhead {elapsed:.3f}s for 220k ops — the "
+            "null objects are no longer no-ops")
+
+    def test_configure_flips_and_restores(self):
+        obs.configure(enabled=True, reset=True)
+        try:
+            assert obs.obs_enabled()
+            assert obs.get_registry() is not obs_metrics.NULL_REGISTRY
+            assert obs_spans.get_recorder().enabled
+        finally:
+            obs.configure(enabled=None, reset=True)
+        assert not obs.obs_enabled()
+
+    def test_registry_or_local_always_counts(self):
+        reg = obs.registry_or_local()
+        assert reg.enabled
+        c = reg.counter("mpit_local_total")
+        c.inc()
+        assert c.value == 1
+
+
+# ---------------------------------------------------------------------------
+# spans + trace export
+
+
+class TestSpans:
+    def test_op_span_records_phases_and_histogram(self, obs_on):
+        rec = obs_spans.get_recorder()
+        sp = rec.op("GRAD", peer=3, side="client", epoch=0)
+        sp.mark("encode")
+        sp.mark("send")
+        sp.note(seq=7)
+        sp.end("ok", retries=1)
+        sp.end("ignored")  # idempotent
+        assert len(rec.spans) == 1
+        done = rec.spans[0]
+        assert done.outcome == "ok"
+        assert done.args["seq"] == 7 and done.args["retries"] == 1
+        assert [p for p, _ in done.marks] == ["encode", "send"]
+        h = obs_on.histogram("mpit_ps_op_seconds", op="GRAD", side="client")
+        assert h.count == 1
+
+    def test_scheduler_records_task_lifecycles(self, obs_on):
+        sched = Scheduler(idle_usec=0)
+        sched.spawn(aio_sleep(0.01), name="nap")
+        sched.wait()
+        rec = obs_spans.get_recorder()
+        names = [name for name, _, _, state in rec.tasks]
+        assert "nap" in names
+        assert obs_on.counter("mpit_aio_steps_total").value > 0
+        assert obs_on.counter("mpit_aio_tasks_total").value >= 1
+
+
+class TestTraceExport:
+    def test_round_trip_and_balance(self, obs_on, tmp_path):
+        rec = obs_spans.get_recorder()
+        for i in range(3):
+            sp = rec.op("GRAD", peer=0, side="client", epoch=0, seq=i + 1)
+            sp.mark("send")
+            sp.end("ok")
+        tok = rec.task_begin("svc")
+        rec.task_end(tok, "svc", "DONE")
+        path = obs_trace.write_rank_trace(str(tmp_path / "t.json"), 7,
+                                          role="client")
+        stats = obs_trace.validate_trace(path)
+        assert stats["ops"] == 3 and stats["tasks"] == 1
+        obj = json.load(open(path))
+        assert obj["otherData"]["ranks"]["7"]["role"] == "client"
+        # merged file validates too and keeps the pid
+        merged = str(tmp_path / "m.json")
+        obs_trace.merge_traces(merged, [path])
+        assert obs_trace.validate_trace(merged)["pids"] == 1
+
+    def test_validator_rejects_malformed(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"traceEvents": [
+            {"ph": "E", "name": "GRAD", "pid": 0, "tid": 1, "ts": 1.0}]}))
+        with pytest.raises(ValueError, match="no open B"):
+            obs_trace.validate_trace(str(bad))
+        bad.write_text(json.dumps({"traceEvents": [
+            {"ph": "B", "name": "GRAD", "pid": 0, "tid": 1, "ts": 1.0}]}))
+        with pytest.raises(ValueError, match="unclosed"):
+            obs_trace.validate_trace(str(bad))
+        bad.write_text(json.dumps({"nope": 1}))
+        with pytest.raises(ValueError, match="traceEvents"):
+            obs_trace.validate_trace(str(bad))
+
+    def test_cli_entry(self, obs_on, tmp_path, capsys):
+        rec = obs_spans.get_recorder()
+        sp = rec.op("PARAM", peer=0)
+        sp.end("ok")
+        path = obs_trace.write_rank_trace(str(tmp_path / "t.json"), 0)
+        assert obs_trace.main([path]) == 0
+        assert obs_trace.main([str(tmp_path / "missing.json")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# back-compat: the utils/timers fold
+
+
+class TestTimersFold:
+    def test_utils_reexports_are_the_obs_objects(self):
+        from mpit_tpu import utils
+        from mpit_tpu.obs import timers as obs_timers
+        from mpit_tpu.utils import timers as utils_timers
+
+        assert utils_timers.PhaseTimers is obs_timers.PhaseTimers
+        assert utils.trace_annotation is obs_timers.trace_annotation
+        assert utils_timers.profiler_trace is obs_timers.profiler_trace
+        assert obs.PhaseTimers is obs_timers.PhaseTimers
+
+    def test_phase_timers_still_work(self):
+        tm = obs.PhaseTimers()
+        with tm.phase("feval"):
+            pass
+        assert tm.count["feval"] == 1
+
+
+# ---------------------------------------------------------------------------
+# deterministic counters under seeded fault plans (2s/2c gang)
+
+
+def launch_gang(nservers, nclients, client_plans=None,
+                client_ft=FAST_FT, server_ft=None):
+    """FT PS topology over LocalRouter with FaultyTransport client seams
+    (the test_ft.py harness shape, trimmed to what these tests need)."""
+    n = nservers + nclients
+    router = LocalRouter(n)
+    sranks, cranks = list(range(nservers)), list(range(nservers, n))
+    server_ft = server_ft or FTConfig(rejoin=True)
+    servers, threads = [], []
+    for r in sranks:
+        servers.append(ParamServer(r, cranks, router.endpoint(r), rule="add",
+                                   ft=server_ft))
+        threads.append(threading.Thread(target=servers[-1].start, daemon=True))
+    for t in threads:
+        t.start()
+    clients, transports = [], []
+    for i, r in enumerate(cranks):
+        ep = router.endpoint(r)
+        plan = (client_plans or {}).get(i)
+        if plan is not None:
+            ep = FaultyTransport(ep, plan)
+        transports.append(ep)
+        clients.append(ParamClient(r, sranks, ep,
+                                   seed_servers=(r == cranks[0]),
+                                   ft=client_ft))
+    return servers, clients, threads, transports
+
+
+def run_gang(servers, clients, threads, rounds, size=64):
+    rng = np.random.default_rng(7)
+    starters = []
+    params = []
+    for c in clients:
+        p = (rng.normal(size=size).astype(np.float32)
+             if not params else np.zeros(size, np.float32))
+        params.append(p)
+        starters.append(threading.Thread(
+            target=c.start, args=(p, np.zeros(size, np.float32)),
+            daemon=True))
+    for t in starters:
+        t.start()
+    join_all(starters)
+    for r in range(rounds):
+        for c in clients:
+            c.grad[:] = rng.normal(size=size).astype(np.float32)
+            c.async_send_grad()
+            c.wait()
+    for c in clients:
+        c.stop()
+    join_all(threads)
+
+
+def simulate_grad_channel(plan, src, dst, rounds):
+    """Replay the plan's arithmetic for one (client -> server) GRAD
+    channel under the retry protocol: a dropped data frame times out and
+    is resent (the resend advances the per-channel count), a passed or
+    duplicated frame is acked.  Returns (sends, drops, dups)."""
+    sends = drops = dups = 0
+    n = 0
+    for _ in range(rounds):
+        while True:
+            n += 1
+            sends += 1
+            verdict = plan.decide(src, dst, tags.GRAD, n)
+            if verdict == "drop":
+                drops += 1
+                continue  # deadline fires, client resends
+            if verdict == "dup":
+                dups += 1
+            break  # delivered (possibly twice) -> acked
+    return sends, drops, dups
+
+
+class TestDeterministicCounters:
+    def test_drop_plan_counters_match_plan_arithmetic(self):
+        """Every-3rd GRAD dropped on each client->server channel: the
+        transport drop counters, the client retry counters and the
+        server dedup counters must equal the replayed plan arithmetic
+        exactly — not approximately."""
+        rounds, nservers, nclients = 6, 2, 2
+        plans = {i: FaultPlan(seed=i, drop_every=3,
+                              tags=frozenset({tags.GRAD}))
+                 for i in range(nclients)}
+        servers, clients, threads, transports = launch_gang(
+            nservers, nclients, client_plans=plans)
+        run_gang(servers, clients, threads, rounds)
+        for i, (c, tr) in enumerate(zip(clients, transports)):
+            want_drops = want_retries = 0
+            for dst in range(nservers):
+                _, drops, dups = simulate_grad_channel(
+                    plans[i], c.rank, dst, rounds)
+                assert dups == 0
+                want_drops += drops
+                # every dropped GRAD costs exactly one resend
+                want_retries += drops
+            assert tr.dropped == want_drops
+            assert c.retries == want_retries
+            assert want_drops > 0  # the plan actually fired
+        # drops never reach the server: no dups, no stale, all applied
+        assert sum(s.dup_ops for s in servers) == 0
+        assert sum(s.stale_drops for s in servers) == 0
+        # one GRAD per (client, server) pair per round (sharded vector)
+        assert (sum(s.grads_applied for s in servers)
+                == rounds * nclients * nservers)
+
+    def test_dup_plan_counters_match_plan_arithmetic(self):
+        """Every-2nd data frame duplicated: the server's dup counter
+        must equal the transports' duplication counters exactly (each
+        injected duplicate is admitted DUP and re-acked), with zero
+        retries — duplication never stalls the op."""
+        rounds, nservers, nclients = 5, 2, 2
+        plans = {i: FaultPlan(seed=i, dup_every=2, tags=DATA_TAGS)
+                 for i in range(nclients)}
+        servers, clients, threads, transports = launch_gang(
+            nservers, nclients, client_plans=plans)
+        run_gang(servers, clients, threads, rounds)
+        injected = sum(tr.duplicated for tr in transports)
+        assert injected > 0
+        assert sum(s.dup_ops for s in servers) == injected
+        assert sum(c.retries for c in clients) == 0
+        assert (sum(s.grads_applied for s in servers)
+                == rounds * nclients * nservers)
+
+    def test_fault_plan_env_spec_drives_the_same_counters(self, monkeypatch):
+        """The env-spec path (MPIT_FT_FAULT_PLAN) parses to the same
+        plan object the direct tests use — the deterministic-counter
+        contract holds for env-configured gangs too."""
+        monkeypatch.setenv("MPIT_FT_FAULT_PLAN",
+                           f"seed=0,drop_every=3,tags={tags.GRAD}")
+        plan = FaultPlan.from_env()
+        assert plan == FaultPlan(seed=0, drop_every=3,
+                                 tags=frozenset({tags.GRAD}))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: fault-injected gang -> attributable trace
+
+
+class TestFaultTraceAttribution:
+    def test_dropped_then_retried_op_is_attributable(self, obs_on, tmp_path):
+        """2s/2c gang under an every-k drop plan with obs enabled: the
+        exported Chrome trace must contain the retried GRAD op's span
+        with its [epoch, seq] identity and retry count, the trace must
+        validate (balanced B/E), and the drop/retry/dup counters must
+        match the plan arithmetic."""
+        rounds, nservers, nclients = 4, 2, 2
+        plans = {0: FaultPlan(seed=0, drop_every=2,
+                              tags=frozenset({tags.GRAD}))}
+        servers, clients, threads, transports = launch_gang(
+            nservers, nclients, client_plans=plans)
+        run_gang(servers, clients, threads, rounds)
+        # counters match the plan arithmetic on both ends
+        want_drops = want_retries = 0
+        for dst in range(nservers):
+            _, drops, _ = simulate_grad_channel(
+                plans[0], clients[0].rank, dst, rounds)
+            want_drops += drops
+            want_retries += drops
+        assert transports[0].dropped == want_drops > 0
+        assert clients[0].retries == want_retries
+        assert sum(s.dup_ops for s in servers) == 0  # drops, not dups
+        # export + validate
+        path = obs_trace.write_rank_trace(
+            str(tmp_path / "trace.json"), rank=clients[0].rank, role="worker")
+        stats = obs_trace.validate_trace(path)
+        assert stats["ops"] > 0
+        # the retried op is attributable: a GRAD span with retries >= 1
+        # carrying its [epoch, seq] identity and per-attempt phases
+        obj = json.load(open(path))
+        begins = {}
+        retried = None
+        for ev in obj["traceEvents"]:
+            if ev["ph"] == "B" and ev["name"] == "GRAD":
+                begins[(ev["tid"], ev["ts"])] = ev
+                if ev["args"].get("retries", 0) >= 1:
+                    retried = ev
+        assert retried is not None, "no retried GRAD span in the trace"
+        assert retried["args"]["epoch"] == 0
+        assert retried["args"]["seq"] >= 1
+        assert retried["args"]["peer"] in range(nservers)
+        # its phase events exist on the same tid, including the backoff
+        phases = {ev["name"] for ev in obj["traceEvents"]
+                  if ev["ph"] == "X" and ev["tid"] == retried["tid"]}
+        assert "GRAD.backoff" in phases and "GRAD.send" in phases
+        # server-side spans recorded the applies (same process here, so
+        # the shared recorder holds both sides)
+        server_grads = [sp for sp in obs_spans.get_recorder().spans
+                        if sp.name == "GRAD"
+                        and sp.args.get("side") == "server"]
+        assert (sum(1 for sp in server_grads if sp.outcome == "applied")
+                == rounds * nclients * nservers)
+
+
+# ---------------------------------------------------------------------------
+# process-gang smoke: per-rank parts merged by the launcher (slow)
+
+
+@pytest.mark.slow
+def test_gang_merges_rank_traces(tmp_path, monkeypatch):
+    """np=3 process gang with MPIT_OBS_TRACE: every child writes a part,
+    the parent merges them, the merged trace validates and carries one
+    pid per rank plus per-rank metrics riders."""
+    from mpit_tpu.train.launch import LAUNCH_DEFAULTS, launch_processes
+
+    trace_path = str(tmp_path / "gang_trace.json")
+    monkeypatch.setenv("MPIT_OBS_TRACE", trace_path)
+    cfg = LAUNCH_DEFAULTS.merged(
+        np=3, opt="downpour", epochs=1, model="linear", side=8,
+        batch=64, master_freq=2, device_policy="cpu",
+    )
+    results = launch_processes(cfg, timeout=600)
+    assert set(results) == {0, 1, 2}
+    stats = obs_trace.validate_trace(trace_path)
+    assert stats["pids"] == 3 and stats["events"] > 0
+    obj = json.load(open(trace_path))
+    ranks = obj["otherData"]["ranks"]
+    assert set(ranks) == {"0", "1", "2"}
+    server_metrics = ranks["0"]["metrics"]
+    assert any(k.startswith("mpit_ps_grads_applied_total")
+               for k in server_metrics)
+    assert not list(tmp_path.glob("gang_trace.json.rank*")), \
+        "part files should be cleaned up after the merge"
