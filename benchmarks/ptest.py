@@ -73,9 +73,24 @@ HEARTBEAT_SWEEP = os.environ.get("MPIT_BENCH_HEARTBEAT", "") not in ("", "0")
 # exit, off the timed window); what this measures is the per-op span
 # and per-message counter cost.
 OBS_SWEEP = os.environ.get("MPIT_BENCH_OBS", "") not in ("", "0")
+# MPIT_BENCH_SKEW=1: run the shm leg twice more under an injected
+# straggler — one server's replies are delay-injected (ft/faults.py,
+# MPIT_BENCH_SKEW_POLLS test()-polls per reply) — first with the
+# shardctl rebalance policy off (static map), then on.  The on-leg's
+# controller migrates the slow server's shard away once its busy-report
+# dominates, so the column pair measures what the rebalancer is worth
+# under skew (docs/PROTOCOL.md §7.6; ISSUE 5 bar: on >= 1.2x off).
+SKEW_SWEEP = os.environ.get("MPIT_BENCH_SKEW", "") not in ("", "0")
+# 600 polls per reply ~ hundreds of ms of straggle per ack at bench
+# scale — enough to dominate a round (40 was invisible next to a
+# multi-MB shard transfer, measured off==on within noise).
+SKEW_POLLS = int(os.environ.get("MPIT_BENCH_SKEW_POLLS", "600"))
+SKEW_DEADLINE = float(os.environ.get("MPIT_BENCH_SKEW_DEADLINE", "30"))
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
 # (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
+# Skew legs are excluded: a deliberately-injected straggler is not a
+# regression.
 BASELINE = float(os.environ.get("MPIT_BENCH_BASELINE", "0") or 0)
 
 
@@ -96,12 +111,14 @@ def bench_ici() -> dict:
 
 
 def bench_shm(codec: str = "", heartbeat: bool = False,
-              obs: bool = False) -> dict:
+              obs: bool = False, skew_rebalance=None) -> dict:
     """One shm PS push/pull measurement; ``codec`` overrides
     MPIT_PS_CODEC for the gang (read at client/server construction);
     ``heartbeat`` arms client beacons + the server lease registry;
     ``obs`` enables the observability registry + op spans (MPIT_OBS)
-    inside every gang child."""
+    inside every gang child; ``skew_rebalance`` (None = no skew)
+    delay-injects the last server's replies and runs the gang in
+    shardctl mode with the rebalance policy off (False) or on (True)."""
     import numpy as np
 
     from mpit_tpu.comm import codec as codec_mod
@@ -113,21 +130,26 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
     _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, codec "
          f"{codec_name}, heartbeat {'on' if heartbeat else 'off'}, "
          f"obs {'on' if obs else 'off'}, "
-         f"payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
+         + (f"skew rebalance={'on' if skew_rebalance else 'off'}, "
+            if skew_rebalance is not None else "")
+         + f"payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
 
     if (heartbeat or obs) and GANG != "procs":
         raise RuntimeError(
             "MPIT_BENCH_HEARTBEAT/MPIT_BENCH_OBS need MPIT_BENCH_GANG=procs")
+    if skew_rebalance is not None and GANG != "procs":
+        raise RuntimeError("MPIT_BENCH_SKEW needs MPIT_BENCH_GANG=procs")
     if GANG == "procs":
-        runs = [_shm_run_procs(size, heartbeat=heartbeat, obs=obs)
+        runs = [_shm_run_procs(size, heartbeat=heartbeat, obs=obs,
+                               skew_rebalance=skew_rebalance)
                 for _ in range(REPS)]
     else:
         runs = [_shm_run_threads(size, heartbeat=heartbeat)
                 for _ in range(REPS)]
     mbs = float(np.median(np.asarray(runs)))
-    _log(f"[shm] codec {codec_name} hb={int(heartbeat)} obs={int(obs)}: "
-         f"median {mbs:.1f} MB/s over {runs}")
-    return {
+    _log(f"[shm] codec {codec_name} hb={int(heartbeat)} obs={int(obs)} "
+         f"skew={skew_rebalance}: median {mbs:.1f} MB/s over {runs}")
+    row = {
         "metric": "ps_pushpull_bandwidth_shm",
         "value": round(mbs, 1),
         "unit": "MB/s",
@@ -140,6 +162,11 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
         "clients": NCLIENTS,
         "servers": NSERVERS,
     }
+    if skew_rebalance is not None:
+        row["skew"] = 1
+        row["rebalance"] = int(bool(skew_rebalance))
+        row["skew_polls"] = SKEW_POLLS
+    return row
 
 
 _GANG_SEQ = [0]  # unique shm namespace per gang within this process
@@ -158,15 +185,16 @@ def _ring_bytes(size: int) -> int:
 
 
 def _shm_run_procs(size: int, heartbeat: bool = False,
-                   obs: bool = False) -> float:
+                   obs: bool = False, skew_rebalance=None) -> float:
     """One timed gang, one OS process per rank: servers run the PS serve
     loop, clients run T rounds of {pull, push, wait} and report their
     round-loop window; aggregate MB/s uses the union of the client
-    windows, so child startup (jax import, seeding) is excluded."""
+    windows, so child startup (jax import, seeding) is excluded.  Skew
+    mode adds one controller rank and delay-injects the last server."""
     import subprocess
     import tempfile
 
-    nranks = NSERVERS + NCLIENTS
+    nranks = NSERVERS + NCLIENTS + (1 if skew_rebalance is not None else 0)
     _GANG_SEQ[0] += 1
     ns = f"ptest_{os.getpid()}_{_GANG_SEQ[0]}"
     spec = {
@@ -174,6 +202,11 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
         "size": size, "ring": _ring_bytes(size), "rounds": ROUNDS,
         "heartbeat": int(heartbeat),
     }
+    if skew_rebalance is not None:
+        spec["skew"] = {"slow_server": NSERVERS - 1,
+                        "delay_polls": SKEW_POLLS,
+                        "rebalance": int(bool(skew_rebalance)),
+                        "deadline_s": SKEW_DEADLINE}
     tmpdir = tempfile.mkdtemp(prefix=f"{ns}_")
     procs, result_files = [], []
     for rank in range(nranks):
@@ -217,7 +250,7 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
             if p.poll() is None:
                 p.kill()
     windows = []
-    for rank in range(NSERVERS, nranks):
+    for rank in range(NSERVERS, NSERVERS + NCLIENTS):
         with open(result_files[rank]) as fh:
             rec = json.load(fh)
         windows.append((rec["t0"], rec["t1"]))
@@ -234,19 +267,24 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
 def _gang_child() -> None:
     """One rank of the process gang (--gang-child): a server runs the
     serve loop to completion; a client times its round loop and writes
-    the window to PTEST_RESULT."""
+    the window to PTEST_RESULT; in skew mode the extra last rank runs
+    the shard controller and the last *server* rank's replies are
+    delay-injected (the straggler under test)."""
     import numpy as np
 
     from mpit_tpu.comm.collectives import HostCollectives
     from mpit_tpu.comm.shm import ShmTransport
-    from mpit_tpu.ft import FTConfig
-    from mpit_tpu.ps import ParamClient, ParamServer
+    from mpit_tpu.ft import FaultPlan, FaultyTransport, FTConfig
+    from mpit_tpu.ps import ParamClient, ParamServer, tags
 
     spec = json.loads(os.environ["PTEST_GANG"])
     rank = int(os.environ["PTEST_RANK"])
-    nranks = spec["nservers"] + spec["nclients"]
+    skew = spec.get("skew")
+    nranks = spec["nservers"] + spec["nclients"] + (1 if skew else 0)
     sranks = list(range(spec["nservers"]))
-    cranks = list(range(spec["nservers"], nranks))
+    cranks = list(range(spec["nservers"],
+                        spec["nservers"] + spec["nclients"]))
+    ctl_rank = nranks - 1 if skew else None
     size = spec["size"]
     heartbeat = bool(spec.get("heartbeat"))
     # Explicit FTConfig either way: the A/B must measure the heartbeat
@@ -257,14 +295,41 @@ def _gang_child() -> None:
     # production-tight TTL evicts a live client mid-leg and wedges it.
     client_ft = FTConfig(heartbeat_s=0.05) if heartbeat else FTConfig()
     server_ft = FTConfig(lease_ttl_s=120.0) if heartbeat else FTConfig()
+    if skew:
+        # Shardctl mode: framed ops with a deadline sized for the leg's
+        # delayed straggler replies, beats for the controller's window.
+        client_ft = FTConfig(op_deadline_s=float(skew["deadline_s"]),
+                             max_retries=8)
+        server_ft = FTConfig(heartbeat_s=0.05)
     transport = ShmTransport(spec["ns"], rank, nranks,
                              ring_bytes=spec["ring"])
     # Startup barrier: no PS traffic until every ring is mapped (the
     # mpirun-gives-you-this guarantee, same as train/gang.py).
     HostCollectives(transport).barrier()
-    if rank in sranks:
-        server = ParamServer(rank, cranks, transport, rule="add",
-                             ft=server_ft)
+    if skew and rank == ctl_rank:
+        from mpit_tpu.shardctl import RebalancePolicy, ShardController
+
+        ctl = ShardController(
+            rank, transport, sranks, cranks,
+            policy=RebalancePolicy(ratio=2.0, min_busy_s=0.01,
+                                   cooldown_s=0.5,
+                                   enabled=bool(skew["rebalance"])),
+        )
+        ctl.serve()
+        result = {"role": "controller",
+                  "rebalances": int(ctl._m_rebal.value),
+                  "map_version": getattr(ctl.smap, "version", None)}
+    elif rank in sranks:
+        ep = transport
+        if skew and rank == skew["slow_server"]:
+            # The straggler: every reply crawls out delay_polls
+            # test()-polls late (send-side injection, message-atomic).
+            ep = FaultyTransport(ep, FaultPlan(
+                delay_every=1, delay_polls=int(skew["delay_polls"]),
+                tags=frozenset({tags.GRAD_ACK, tags.PARAM,
+                                tags.PARAM_PUSH_ACK})))
+        server = ParamServer(rank, cranks, ep, rule="add",
+                             ft=server_ft, controller_rank=ctl_rank)
         server.start()
         result = {
             "role": "server", "grads_applied": server.grads_applied,
@@ -275,7 +340,8 @@ def _gang_child() -> None:
     else:
         client = ParamClient(rank, sranks, transport,
                              seed_servers=(rank == cranks[0]),
-                             ft=client_ft)
+                             ft=client_ft, shardctl=bool(skew),
+                             controller_rank=ctl_rank)
         param = np.zeros(size, np.float32)
         grad = np.full(size, 1e-6, np.float32)
         client.start(param, grad)
@@ -405,13 +471,18 @@ def main():
                            for hb in hb_modes for ob in obs_modes)
         else:
             results.extend(_bench_shm_subprocess(c) for c in sweep)
+    if SKEW_SWEEP and MODE in ("shm", "both"):
+        # The straggler A/B runs at codec=none (the skew is in the
+        # *reply latency*, not the byte volume): rebalance off, then on.
+        results.append(bench_shm("none", skew_rebalance=False))
+        results.append(bench_shm("none", skew_rebalance=True))
     for r in results:
         print(json.dumps(r))
     if BASELINE > 0:
         low = [
             r for r in results
             if r.get("codec") == "none" and r["metric"].endswith("_shm")
-            and r["value"] < 0.97 * BASELINE
+            and not r.get("skew") and r["value"] < 0.97 * BASELINE
         ]
         if low:
             raise SystemExit(

@@ -21,6 +21,15 @@ rendezvous conventions).  This pass makes it machine-checked:
   generated bindings by re-running the (stdlib-only) generator and
   comparing output — spec drift is MT-P105.
 
+The MT-P5xx family checks **tag registration**: every tag defined in a
+``tags.py`` module must (MT-P501) carry an entry in the module's
+``TAG_PAIRS`` conformance table naming its sender/receiver roles, and
+(MT-P502) appear in the tree's ``docs/PROTOCOL.md`` normative spec when
+one exists.  Entries whose endpoints are not plain client<->server
+(controller directives, server<->server migration traffic) are *only*
+checkable this way — the binary role model of MT-P101/P102 exempts
+them, so the table is what keeps those channels from going dark.
+
 The MT-P2xx family checks **bounded-wait discipline** (the mpit_tpu.ft
 contract): in a role file, every ``aio_send``/``aio_recv`` must carry an
 explicit ``deadline=`` or ``abort=`` keyword (MT-P201) — a bare ``live=``
@@ -98,6 +107,43 @@ def _load_tag_table(files: List[SourceFile]):
     return table, lines
 
 
+def _load_tag_pairs(files: List[SourceFile]) -> Dict[str, Tuple[str, str]]:
+    """Merge every tags.py ``TAG_PAIRS = {"NAME": (sender, receiver)}``
+    literal into one conformance pairing table (the MT-P5xx anchor)."""
+    pairs: Dict[str, Tuple[str, str]] = {}
+    for src in files:
+        if src.path.stem != "tags":
+            continue
+        for node in src.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "TAG_PAIRS"
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            for key, value in zip(node.value.keys, node.value.values):
+                if not (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)):
+                    continue
+                roles = []
+                if isinstance(value, ast.Tuple):
+                    roles = [e.value for e in value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str)]
+                if len(roles) == 2:
+                    pairs[key.value] = (roles[0], roles[1])
+    return pairs
+
+
+def _binary_pair(pair: "Tuple[str, str] | None") -> bool:
+    """True when the pairing entry describes plain client<->server
+    traffic — the only shape the binary role model (MT-P101/P102) can
+    check.  Controller / server<->server / multi-role entries are
+    validated against the table + PROTOCOL.md instead (MT-P5xx)."""
+    if pair is None:
+        return True  # unregistered: legacy default (and MT-P501 fires)
+    return set(pair) == {"client", "server"}
+
+
 def _role_of(src: SourceFile) -> Optional[str]:
     stem = src.path.stem.lower()
     if "client" in stem:
@@ -171,7 +217,8 @@ def _extract_ops_call(node: ast.Call, table) -> List[ProtoOp]:
 _PEER = {"client": "server", "server": "client"}
 
 
-def _check_pairing(table, tag_lines, fns: List[RoleFn]) -> List[Finding]:
+def _check_pairing(table, tag_lines, fns: List[RoleFn],
+                   pairs: Dict[str, Tuple[str, str]]) -> List[Finding]:
     findings: List[Finding] = []
     used: set = set()
     by_role: Dict[str, List[RoleFn]] = {"client": [], "server": []}
@@ -180,9 +227,12 @@ def _check_pairing(table, tag_lines, fns: List[RoleFn]) -> List[Finding]:
         for op in fn.ops:
             used.add(op.tag)
 
-    # MT-P101: tag in the table, never used by any role.
+    # MT-P101: tag in the table, never used by any role.  Tags whose
+    # pairing entry names non-client/server endpoints (controller,
+    # server<->server) live outside the binary role model — their
+    # conformance is the MT-P5xx table+doc check.
     for name, (src, line) in sorted(tag_lines.items()):
-        if name not in used:
+        if name not in used and _binary_pair(pairs.get(name)):
             findings.append(src.finding(
                 "MT-P101", line,
                 f"tag {name} is defined but no client/server send or recv "
@@ -198,7 +248,7 @@ def _check_pairing(table, tag_lines, fns: List[RoleFn]) -> List[Finding]:
     for fn in fns:
         for op in fn.ops:
             key = (fn.role, op.kind, op.tag)
-            if key in seen:
+            if key in seen or not _binary_pair(pairs.get(op.tag)):
                 continue
             seen.add(key)
             peer = _PEER[fn.role]
@@ -210,6 +260,49 @@ def _check_pairing(table, tag_lines, fns: List[RoleFn]) -> List[Finding]:
                     f"{fn.role} {verb} tag {op.tag} but the {peer} role has "
                     f"no matching {want} — one side of this channel is "
                     "unimplemented"))
+    return findings
+
+
+def _check_tag_registration(tag_lines, pairs,
+                            files: List[SourceFile]) -> List[Finding]:
+    """MT-P501/MT-P502: every tag must be registered in the TAG_PAIRS
+    conformance table and documented in docs/PROTOCOL.md.
+
+    The doc is located relative to the scan root (``<root>/docs`` or
+    ``<root>/../docs``) — never by walking arbitrarily upward, so a
+    fixture tree can't accidentally validate against the real repo's
+    spec.  A tree with no PROTOCOL.md skips MT-P502.
+    """
+    findings: List[Finding] = []
+    doc_text: Optional[str] = None
+    for src in files:
+        if src.path.stem != "tags":
+            continue
+        rel = pathlib.PurePosixPath(src.rel)
+        root = src.path
+        for _ in range(len(rel.parts)):
+            root = root.parent
+        for base in (root, root.parent):
+            candidate = base / "docs" / "PROTOCOL.md"
+            if candidate.is_file():
+                doc_text = candidate.read_text()
+                break
+        break
+    import re
+
+    for name, (src, line) in sorted(tag_lines.items()):
+        if name not in pairs:
+            findings.append(src.finding(
+                "MT-P501", line,
+                f"tag {name} has no entry in the TAG_PAIRS conformance "
+                "table — every wire tag must declare its sender/receiver "
+                "roles (ps/tags.py)"))
+        if doc_text is not None and not re.search(
+                rf"\b{re.escape(name)}\b", doc_text):
+            findings.append(src.finding(
+                "MT-P502", line,
+                f"tag {name} does not appear in docs/PROTOCOL.md — the "
+                "normative wire spec must document every tag"))
     return findings
 
 
@@ -387,10 +480,12 @@ def check(files: List[SourceFile]) -> List[Finding]:
     findings: List[Finding] = []
     table, tag_lines = _load_tag_table(files)
     if table:
+        pairs = _load_tag_pairs(files)
         fns = _collect_role_fns(files, table)
-        findings += _check_pairing(table, tag_lines, fns)
+        findings += _check_pairing(table, tag_lines, fns, pairs)
         findings += _check_ack_discipline(table, fns)
         findings += _check_deadlock_shape(fns)
+        findings += _check_tag_registration(tag_lines, pairs, files)
     findings += _check_deadline_discipline(files)
     findings += _check_spec_drift(files)
     return findings

@@ -16,9 +16,10 @@ read") become serve-latest-committed snapshots — JAX immutability gives the
 same algorithmic tolerance without torn reads.
 """
 
-from mpit_tpu.ps.sharding import Shard, shard_layout
+from mpit_tpu.ps.sharding import Shard, shard_layout, weighted_layout
 from mpit_tpu.ps.client import ParamClient
 from mpit_tpu.ps.server import ParamServer
 from mpit_tpu.ps import tags
 
-__all__ = ["Shard", "shard_layout", "ParamClient", "ParamServer", "tags"]
+__all__ = ["Shard", "shard_layout", "weighted_layout", "ParamClient",
+           "ParamServer", "tags"]

@@ -78,7 +78,9 @@ from mpit_tpu.ft import (
 )
 from mpit_tpu.obs import NULL_SPAN, get_recorder, registry_or_local
 from mpit_tpu.ps import tags
-from mpit_tpu.ps.sharding import Shard, shard_layout
+from mpit_tpu.ps.sharding import Shard
+from mpit_tpu.shardctl import shardmap as _shardmap
+from mpit_tpu.shardctl import wire as _scwire
 from mpit_tpu.utils.logging import get_logger
 
 
@@ -92,6 +94,9 @@ class ParamClient:
         seed_servers: bool = False,
         codec: Optional[str] = None,
         ft: Optional[FTConfig] = None,
+        shard_map: "Optional[_shardmap.ShardMap]" = None,
+        shardctl: bool = False,
+        controller_rank: Optional[int] = None,
     ):
         self.rank = rank
         self.sranks = list(server_ranks)
@@ -100,6 +105,20 @@ class ParamClient:
         self.seed_servers = seed_servers  # this is the first client
         self.codec = codec_mod.get(codec)  # None/'' -> $MPIT_PS_CODEC
         self.ft = ft if ft is not None else FTConfig.from_env()
+        # shardctl (mpit_tpu.shardctl): ops address *shards*, not
+        # servers — the versioned map routes them, a NACK_MAP reply
+        # re-routes them, and the controller's MAP_UPDATE broadcasts are
+        # polled opportunistically.  Requires the FT framed machinery:
+        # re-routing is retry, and at-most-once across owners is the
+        # transferred dedup state.
+        self._sc = bool(shardctl or shard_map is not None)
+        self.smap = shard_map
+        self.controller_rank = controller_rank
+        if self._sc and self.ft.op_deadline_s <= 0:
+            raise ValueError(
+                "shardctl needs op deadlines + retry (FTConfig."
+                "op_deadline_s > 0): map re-routing rides the retry path"
+            )
         self._retry = RetryPolicy(self.ft, key=rank)
         self.live = LiveFlag()
         self.log = get_logger("pclient", rank)
@@ -132,6 +151,23 @@ class ParamClient:
             "mpit_ft_backoff_seconds_total", rank=rank)
         self._m_hb = self.metrics.counter(
             "mpit_ft_heartbeats_sent_total", rank=rank)
+        self._m_nacks = self.metrics.counter(
+            "mpit_shardctl_nacks_seen_total", rank=rank)
+        self._m_reroutes = self.metrics.counter(
+            "mpit_shardctl_reroutes_total", rank=rank)
+        self._m_mapver = self.metrics.gauge(
+            "mpit_shardctl_map_version", rank=rank)
+        # shardctl per-shard state: encode staging + residual keyed by
+        # shard_id (stable across migrations — placement moves, the cut
+        # never does), per-(shard, tag) seq streams, one global FIFO op
+        # pump (ops to different owners of one map serialize, so the
+        # shared reply channels never interleave two ops' echoes).
+        self._sc_wire: Dict[int, np.ndarray] = {}
+        self._sc_residual: Dict[int, np.ndarray] = {}
+        self._sc_seq: Dict[Tuple[int, int], int] = {}
+        self._scq: Deque[Tuple[Generator, str]] = deque()
+        self._sc_pump_live = False
+        self._sc_pump_task: Optional[object] = None
         # Per-server FIFO op chains: ops addressed to the same server run in
         # issue order (a send_grad's ack completes before a later param
         # request is sent), while different servers stay fully concurrent.
@@ -148,9 +184,17 @@ class ParamClient:
         """Announce shard layout + codec to every server; the first client
         seeds the servers' shards from ``param`` (reference
         pclient.lua:111-129).  INIT v2: int64 [offset, size, codec_id];
-        with any FT feature active, INIT v3 adds [epoch, flags]."""
+        with any FT feature active, INIT v3 adds [epoch, flags]; under
+        shardctl, INIT v4 announces the whole versioned shard map."""
         self._register(param, grad)
-        self.shards = shard_layout(len(param), len(self.sranks))
+        if self._sc:
+            self._sc_start(param)
+            return
+        # Placement is a ShardMap even on the static path: version-0,
+        # one equal shard per server in rank order — byte-identical to
+        # the raw shard_layout() cut this call site used to make.
+        self.smap = _shardmap.ShardMap.initial(len(param), self.sranks)
+        self.shards = [e.shard for e in self.smap.entries]
         flags = (FLAG_FRAMED if self.ft.framed else 0) | (
             FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0
         )
@@ -325,6 +369,259 @@ class ParamClient:
         except DeadlineExceeded:
             pass  # liveness is best-effort; the next beat tries again
 
+    # -- shardctl: shard-addressed ops over the versioned map ----------------
+
+    def _sc_start(self, param: np.ndarray) -> None:
+        """INIT v4 to every server: codec + FT posture + the whole map.
+        Per-shard staging is keyed by shard_id — placement moves, the
+        cut never does, so buffers survive any number of migrations."""
+        if self.smap is None:
+            self.smap = _shardmap.ShardMap.initial(len(param), self.sranks)
+        if self.smap.plong != len(param):
+            raise ValueError(
+                f"shard map covers {self.smap.plong} elements but the "
+                f"registered vector has {len(param)}")
+        self.shards = [e.shard for e in self.smap.entries]
+        self._m_mapver.set(self.smap.version)
+        flags = FLAG_FRAMED | _scwire.FLAG_SHARDCTL | (
+            FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0
+        )
+        for e in self.smap.entries:
+            if self.codec.identity:
+                nbytes = e.shard.size * param.dtype.itemsize
+            else:
+                nbytes = self.codec.wire_nbytes(e.shard.size)
+                if self.codec.uses_residual:
+                    self._sc_residual[e.shard_id] = np.zeros(
+                        e.shard.size, np.float32)
+            self._sc_wire[e.shard_id] = np.zeros(
+                _scwire.SC_HDR_BYTES + nbytes, np.uint8)
+        cinfo = _scwire.init_v4(self.codec.wire_id, self.ft.epoch,
+                                flags, self.smap)
+        for srank in self.sranks:
+            self.sched.spawn(
+                aio_send(self.transport, cinfo, srank, tags.INIT,
+                         live=self.live, deadline=self._op_deadline()),
+                name=f"send_init:{srank}",
+            )
+        self.wait()
+        self._started = True
+        self._hb_last = 0.0
+        if self.controller_rank is not None and self.seed_servers:
+            # Hand the controller its first map (it starts blank so it
+            # never has to know plong before the clients do).
+            self.sched.spawn(
+                aio_send(self.transport,
+                         _scwire.map_update(_scwire.INSTALL, -1, self.rank,
+                                            self.smap),
+                         self.controller_rank, tags.MAP_UPDATE,
+                         live=self.live, deadline=self._op_deadline()),
+                name="send_map:controller",
+            )
+            self.wait()
+        if self.seed_servers:
+            self.async_send_param()
+            self.wait()
+
+    def _sc_next_seq(self, sid: int, tag: int) -> int:
+        seq = self._sc_seq.get((sid, tag), 0) + 1
+        self._sc_seq[(sid, tag)] = seq
+        return seq
+
+    def _sc_install_wire(self, body) -> bool:
+        """Adopt a serialized map if it is newer than ours."""
+        m = _shardmap.ShardMap.from_wire(np.frombuffer(bytes(body), np.int64))
+        if self.smap is None or m.version > self.smap.version:
+            self.smap = m
+            self._m_mapver.set(m.version)
+            return True
+        return False
+
+    def _sc_poll_map(self) -> None:
+        """Drain any MAP_UPDATE broadcasts from the controller (probed,
+        never blocking): proactive re-routing, and the only way to learn
+        a failover map while the old owner is dead air."""
+        if not self._sc or self.controller_rank is None:
+            return
+        while self.transport.iprobe(self.controller_rank, tags.MAP_UPDATE):
+            handle = self.transport.irecv(self.controller_rank,
+                                          tags.MAP_UPDATE)
+            while not self.transport.test(handle):
+                pass  # iprobe saw a fully-assembled message
+            _k, _sid, _peer, m = _scwire.parse_map_update(
+                bytes(self.transport.payload(handle)))
+            if self.smap is None or m.version > self.smap.version:
+                self.smap = m
+                self._m_mapver.set(m.version)
+
+    def _sc_write_op(self, sid: int, tag: int, ack_tag: int, what: str):
+        """One shard write (GRAD / PARAM_PUSH): encode once into the
+        shard's staging frame, then run the attempt loop.  The residual
+        folds at this single encode; re-routes resend the same bytes."""
+        shard = self.smap.entry(sid).shard
+        span = self._spans.op(what, peer=sid, side="client")
+        view = (self.grad if tag == tags.GRAD else
+                self.param)[shard.offset: shard.end]
+        wire = self._sc_wire[sid]
+        span.mark("encode")
+        body = wire[_scwire.SC_HDR_BYTES:]
+        if self.codec.identity:
+            body[:] = view.view(np.uint8)
+        else:
+            residual = (self._sc_residual.get(sid)
+                        if tag == tags.GRAD else None)
+            self.codec.encode_into(view, body, residual=residual)
+        seq = self._sc_next_seq(sid, tag)
+        span.note(epoch=self.ft.epoch, seq=seq, shard=sid)
+        yield from self._sc_attempts(sid, seq, wire, tag, ack_tag,
+                                     out=None, span=span,
+                                     what=f"{what} for shard {sid}")
+
+    def _sc_read_op(self, sid: int):
+        """One shard read: request-by-header, decode the OK reply's
+        snapshot frame into the param slice."""
+        shard = self.smap.entry(sid).shard
+        span = self._spans.op("PARAM", peer=sid, side="client")
+        out = self.param[shard.offset: shard.end]
+        seq = self._sc_next_seq(sid, tags.PARAM_REQ)
+        span.note(epoch=self.ft.epoch, seq=seq, shard=sid)
+        yield from self._sc_attempts(sid, seq, None, tags.PARAM_REQ,
+                                     tags.PARAM, out=out, span=span,
+                                     what=f"PARAM read for shard {sid}")
+
+    def _sc_attempts(self, sid: int, seq: int, wire: Optional[np.ndarray],
+                     tag: int, ack_tag: int, out: Optional[np.ndarray],
+                     span, what: str):
+        """The shardctl attempt loop: send to the shard's current owner,
+        await the status reply; DeadlineExceeded retries under backoff
+        (polling controller broadcasts), NACK_MAP installs the carried
+        map and re-routes, BUSY backs off through a migration window.
+        A re-route to a *different* owner resets the attempt budget —
+        monotone map versions bound the total work.  Exhaustion raises
+        :class:`RetryExhausted`; the never-hang guarantee holds."""
+        attempt = 0
+        nacks = 0
+        max_nacks = 16 * (self._retry.attempts + 1)
+        last: Optional[BaseException] = None
+        while self.live.io:
+            owner = self.smap.owner(sid)
+            if wire is not None:
+                _scwire.pack_sc_header(wire, self.ft.epoch, seq,
+                                       self.smap.version, sid)
+                payload: np.ndarray = wire
+            else:
+                payload = _scwire.sc_header(self.ft.epoch, seq,
+                                            self.smap.version, sid)
+            deadline = self._op_deadline()
+            try:
+                span.mark("send")
+                yield from aio_send(self.transport, payload, owner, tag,
+                                    live=self.live, deadline=deadline)
+                span.mark("recv" if out is not None else "ack")
+                while True:
+                    raw = yield from aio_recv(self.transport, owner, ack_tag,
+                                              live=self.live,
+                                              deadline=deadline)
+                    if raw is None:
+                        span.end("aborted")
+                        return None
+                    epoch, aseq, status, rsid, body = _scwire.parse_reply(
+                        bytes(raw))
+                    if epoch == self.ft.epoch and rsid == sid and aseq == seq:
+                        break
+                    if epoch > self.ft.epoch or (
+                            epoch == self.ft.epoch and rsid == sid
+                            and aseq > seq):
+                        raise RuntimeError(
+                            f"reply from server {owner} is ahead of the op "
+                            f"stream: got (epoch={epoch}, seq={aseq}, "
+                            f"shard={rsid}), awaiting (epoch="
+                            f"{self.ft.epoch}, seq={seq}, shard={sid})")
+                    # stale echo (earlier attempt / other shard): drop on
+                    # the unchanged attempt deadline
+            except DeadlineExceeded as exc:
+                last = exc
+                attempt += 1
+                if attempt >= self._retry.attempts:
+                    span.end("exhausted")
+                    raise RetryExhausted(what, self._retry.attempts, last)
+                backoff = self._retry.backoff_s(attempt)
+                self._m_retries.inc()
+                self._m_backoff.inc(backoff)
+                span.mark("backoff")
+                span.note(retries=attempt)
+                if not (yield from aio_sleep(backoff, live=self.live)):
+                    span.end("aborted")
+                    return None
+                self._sc_poll_map()
+                if self.smap.owner(sid) != owner:
+                    # A broadcast re-routed us (failover away from a dead
+                    # owner): the new destination gets a fresh budget.
+                    self._m_reroutes.inc()
+                    span.mark("reroute")
+                    attempt = 0
+                continue
+            if status == _scwire.OK:
+                if out is not None:
+                    span.mark("decode")
+                    self._sc_decode(body, out)
+                span.end("ok")
+                return True
+            # NACK_MAP / BUSY — both may carry the server's newer map.
+            nacks += 1
+            self._m_nacks.inc()
+            span.mark("nack")
+            if nacks > max_nacks:
+                span.end("exhausted")
+                raise RetryExhausted(f"{what} (map churn)", nacks, last)
+            if len(body) and self._sc_install_wire(body) \
+                    and self.smap.owner(sid) != owner:
+                self._m_reroutes.inc()
+                span.mark("reroute")
+                attempt = 0
+            if status == _scwire.BUSY:
+                # Mid-migration freeze window: give the handoff a beat.
+                if not (yield from aio_sleep(self._retry.backoff_s(1),
+                                             live=self.live)):
+                    span.end("aborted")
+                    return None
+                self._sc_poll_map()
+        span.end("aborted")
+        return None
+
+    def _sc_decode(self, body, out: np.ndarray) -> None:
+        frame = np.frombuffer(bytes(body), np.uint8)
+        if self.codec.identity:
+            out.view(np.uint8)[:] = frame
+        else:
+            self.codec.decode_into(frame, out)
+
+    def _sc_enqueue(self, gen: Generator, name: str) -> None:
+        self._scq.append((gen, name))
+        if not self._sc_pump_live:
+            self._sc_pump_live = True
+            self._sc_pump_task = None
+            task = self.sched.spawn(self._sc_pump(), name=f"scpump:{name}")
+            self._sc_pump_task = task
+
+    def _sc_pump(self):
+        """One global FIFO for shardctl ops: strictly serialized, so the
+        per-(owner, tag) reply channels never interleave two in-flight
+        ops' echoes even when one server owns several shards.  (The
+        static path keeps its per-server pumps and full cross-server
+        overlap — serialization is the price of re-routable ops, paid
+        only in shardctl mode.)"""
+        queue = self._scq
+        try:
+            while queue:
+                op, opname = queue.popleft()
+                task = self._sc_pump_task
+                if task is not None:
+                    task.name = f"scpump:{opname}"
+                yield from op
+        finally:
+            self._sc_pump_live = False
+
     # -- per-server transfer generators -------------------------------------
 
     def _send_grad(self, srank: int, shard: Shard):
@@ -474,11 +771,11 @@ class ParamClient:
     def residual_norm(self) -> float:
         """L2 norm of the error-feedback residuals across shards — 0.0
         for residual-free codecs.  Observability/test hook."""
-        if not self._residual:
+        residuals = list(self._residual.values()) + \
+            list(self._sc_residual.values())
+        if not residuals:
             return 0.0
-        return float(np.sqrt(sum(
-            float(np.dot(r, r)) for r in self._residual.values()
-        )))
+        return float(np.sqrt(sum(float(np.dot(r, r)) for r in residuals)))
 
     # -- public async API (reference pclient.lua:84-109) --------------------
 
@@ -508,14 +805,31 @@ class ParamClient:
             self._pump_live[srank] = False
 
     def async_send_grad(self) -> None:
+        if self._sc:
+            for e in self.smap.entries:
+                self._sc_enqueue(
+                    self._sc_write_op(e.shard_id, tags.GRAD, tags.GRAD_ACK,
+                                      "GRAD"), "send_grad")
+            return
         for srank, shard in zip(self.sranks, self.shards):
             self._enqueue(srank, self._send_grad(srank, shard), "send_grad")
 
     def async_recv_param(self) -> None:
+        if self._sc:
+            for e in self.smap.entries:
+                self._sc_enqueue(self._sc_read_op(e.shard_id), "recv_param")
+            return
         for srank, shard in zip(self.sranks, self.shards):
             self._enqueue(srank, self._recv_param(srank, shard), "recv_param")
 
     def async_send_param(self) -> None:
+        if self._sc:
+            for e in self.smap.entries:
+                self._sc_enqueue(
+                    self._sc_write_op(e.shard_id, tags.PARAM_PUSH,
+                                      tags.PARAM_PUSH_ACK, "PARAM_PUSH"),
+                    "send_param")
+            return
         for srank, shard in zip(self.sranks, self.shards):
             self._enqueue(srank, self._send_param(srank, shard), "send_param")
 
@@ -523,6 +837,7 @@ class ParamClient:
         """Single-step I/O progress to overlap with compute
         (reference pclient.lua:131-136)."""
         self._maybe_heartbeat()
+        self._sc_poll_map()
         for _ in range(n):
             self.sched.ping()
 
@@ -533,6 +848,7 @@ class ParamClient:
             # get this client evicted.
             while self.sched.queue:
                 self._maybe_heartbeat()
+                self._sc_poll_map()
                 self.sched.ping_pass()
             if self.sched.errors:
                 raise self.sched.errors.pop(0)
@@ -544,6 +860,22 @@ class ParamClient:
     def stop(self) -> None:
         # Chained per server, so the stop cannot overtake in-flight ops
         # (the reference's drain-then-stop care, init.lua:50-58, README:71).
+        if self._sc:
+            # The global shardctl pump gives the same drain-then-stop
+            # ordering; the controller counts client STOPs too — its
+            # exit condition mirrors the servers'.
+            stop_to = self.sranks + (
+                [self.controller_rank] if self.controller_rank is not None
+                else [])
+            for dst in stop_to:
+                self._sc_enqueue(
+                    aio_send(self.transport, tags.EMPTY, dst, tags.STOP,
+                             live=self.live, deadline=self._op_deadline()),
+                    "send_stop",
+                )
+            self.wait()
+            self.live.stop()
+            return
         for srank in self.sranks:
             self._enqueue(
                 srank,

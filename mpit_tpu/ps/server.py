@@ -66,7 +66,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mpit_tpu.aio import EXEC, LiveFlag, Scheduler, aio_recv, aio_send, aio_sleep
+from mpit_tpu.aio import (
+    EXEC,
+    DeadlineExceeded,
+    LiveFlag,
+    Scheduler,
+    aio_recv,
+    aio_send,
+    aio_sleep,
+    deadline_at,
+)
 from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.ft import (
@@ -83,6 +92,10 @@ from mpit_tpu.ft import (
 from mpit_tpu.obs import get_recorder, registry_or_local
 from mpit_tpu.optim.rules import ShardRule, make as make_rule
 from mpit_tpu.ps import tags
+from mpit_tpu.shardctl import migrate as _scmigrate
+from mpit_tpu.shardctl import wire as _scwire
+from mpit_tpu.shardctl.migrate import ShardSlot
+from mpit_tpu.shardctl.shardmap import ShardMap
 from mpit_tpu.utils.logging import get_logger
 
 
@@ -102,6 +115,7 @@ class ParamServer:
         codec: Optional[str] = None,  # None: adopt each client's announcement;
         #                               a name pins it — mismatches fail loudly
         ft: Optional[FTConfig] = None,
+        controller_rank: Optional[int] = None,  # shardctl control plane
     ):
         self.rank = rank
         self.cranks = list(client_ranks)
@@ -150,6 +164,19 @@ class ParamServer:
         self._req_buf: Dict[int, np.ndarray] = {}
         self._hb_buf: Dict[int, np.ndarray] = {}
         self._restored_clients: set = set()
+        # shardctl (mpit_tpu.shardctl): a versioned map replaces the
+        # single (offset, size) registration; owned shards live in
+        # per-shard slots (param + rule state + shard-scoped dedup +
+        # snapshot cache) that migrate as a unit.  Activated by the
+        # first INIT v4 announcement; mixing v4 and pre-v4 clients on
+        # one server is rejected loudly.
+        self.controller_rank = controller_rank
+        self.smap: Optional[ShardMap] = None
+        self._slots: Dict[int, ShardSlot] = {}
+        self._sc = False
+        self._sc_apply_cache: Dict[Tuple[str, int], Callable] = {}
+        self._sc_last_report: Dict[int, Tuple[int, float]] = {}
+        self._sc_beat_seq = 0
         # Observability (mpit_tpu.obs): every protocol counter lives in
         # a real registry (the global one when obs is enabled, a private
         # one otherwise — they are load-bearing results either way) and
@@ -170,6 +197,18 @@ class ParamServer:
         self._m_snap_hits = _m.counter("mpit_ps_snapshot_hits_total", rank=_r)
         self._m_ckpts = _m.counter("mpit_ps_ckpts_written_total", rank=_r)
         self._m_evictions = _m.counter("mpit_ft_evictions_total", rank=_r)
+        self._m_sc_nacks = _m.counter("mpit_shardctl_nacks_sent_total",
+                                      rank=_r)
+        self._m_sc_busy = _m.counter("mpit_shardctl_busy_replies_total",
+                                     rank=_r)
+        self._m_sc_out = _m.counter("mpit_shardctl_migrations_total",
+                                    rank=_r, direction="out")
+        self._m_sc_in = _m.counter("mpit_shardctl_migrations_total",
+                                   rank=_r, direction="in")
+        self._m_sc_adopt = _m.counter("mpit_shardctl_adoptions_total",
+                                      rank=_r)
+        self._m_sc_ver = _m.gauge("mpit_shardctl_map_version", rank=_r)
+        self._m_sc_owned = _m.gauge("mpit_shardctl_owned_shards", rank=_r)
         # Version-counted snapshot cache: _snap_version bumps on every
         # committed write (grad apply / seed / restore); _snap_host is
         # the one device->host copy for that version and _snap_wire the
@@ -245,6 +284,16 @@ class ParamServer:
     def ckpts_written(self) -> int:
         return int(self._m_ckpts.value)
 
+    # -- shardctl reads (tests / observability) ------------------------------
+
+    @property
+    def owned_shards(self) -> "List[int]":
+        """Shard ids this server currently holds (shardctl mode)."""
+        return sorted(self._slots)
+
+    def shard_param(self, sid: int):
+        return self._slots[sid].param
+
     def _dev_ctx(self):
         """Context placing jnp array creation + jit execution on the
         configured backend (no-op for device='default')."""
@@ -264,6 +313,13 @@ class ParamServer:
         corrupt parameters silently."""
         raw = np.frombuffer(payload, dtype=np.int64)
         epoch, flags = 0, 0
+        if raw.size >= 8 and int(raw[0]) == -1:  # INIT v4 (shardctl)
+            return self._negotiate_v4(crank, raw)
+        if self._sc:
+            raise ValueError(
+                f"client {crank} announced a legacy INIT on a shardctl "
+                "server — a gang is shardctl everywhere or nowhere"
+            )
         if raw.size == 2:  # legacy 16-byte v1 announcement
             offset, size, wire_id = int(raw[0]), int(raw[1]), 0
         elif raw.size == 3:
@@ -306,6 +362,60 @@ class ParamServer:
         self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
         return codec
 
+    def _negotiate_v4(self, crank: int, raw: np.ndarray) -> "codec_mod.Codec":
+        """INIT v4: codec + FT posture + the versioned shard map.  The
+        map replaces the per-pair (offset, size); owned shards become
+        slots.  Shardctl implies framing — re-routable ops need the
+        retry/dedup identity under them."""
+        codec_id, epoch, flags, smap = _scwire.parse_init_v4(raw)
+        if not (flags & FLAG_FRAMED):
+            raise ValueError(
+                f"client {crank} announced shardctl without FLAG_FRAMED — "
+                "shardctl ops ride the framed retry machinery"
+            )
+        if self.offset != -1:
+            raise ValueError(
+                f"client {crank} announced shardctl but server {self.rank} "
+                "already holds a legacy (offset, size) registration"
+            )
+        codec = codec_mod.by_wire_id(codec_id)
+        if self._codec_pin is not None and codec.name != self._codec_pin:
+            raise ValueError(
+                f"codec negotiation mismatch: client {crank} announced "
+                f"{codec.name!r} but server {self.rank} is pinned to "
+                f"{self._codec_pin!r} — align MPIT_PS_CODEC (or the codec "
+                "config) across the gang"
+            )
+        if not codec.identity and np.dtype(self.dtype) != np.float32:
+            raise ValueError(
+                f"codec {codec.name!r} quantizes float32 shards; server "
+                f"{self.rank} holds dtype {np.dtype(self.dtype).name} "
+                "(use codec='none' for other dtypes)"
+            )
+        self._sc = True
+        self._sc_install_map(smap)
+        for e in smap.shards_of(self.rank):
+            if e.shard_id not in self._slots:
+                self._sc_make_slot(e.shard_id, e.shard)
+        self._framed[crank] = True
+        self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
+        self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
+        return codec
+
+    def _sc_install_map(self, smap: ShardMap) -> None:
+        if self.smap is None or smap.version > self.smap.version:
+            self.smap = smap
+            self._m_sc_ver.set(smap.version)
+
+    def _sc_make_slot(self, sid: int, shard) -> ShardSlot:
+        slot = ShardSlot(sid, shard.offset, shard.size)
+        with self._dev_ctx():
+            slot.param = jnp.zeros((shard.size,), dtype=self.dtype)
+            slot.rule_state = self.rule.init(slot.param)
+        self._slots[sid] = slot
+        self._m_sc_owned.set(len(self._slots))
+        return slot
+
     def _hdr_for(self, crank: int) -> int:
         return HDR_BYTES if self._framed.get(crank) else 0
 
@@ -313,6 +423,15 @@ class ParamServer:
         """(Re)allocate every per-client staging buffer for the client's
         negotiated codec + framing — initial INIT and rejoin both land
         here, so a rejoining incarnation may change codec freely."""
+        if self._sc:
+            # Shardctl frames are shard-addressed and variable-size per
+            # shard, so the data paths receive by allocation — the only
+            # fixed-size staging is the 32-byte PARAM_REQ header.
+            self._codecs[crank] = codec
+            self._req_buf[crank] = np.zeros(4, np.int64)
+            if self._hb.get(crank):
+                self._hb_buf[crank] = np.zeros(2, np.int64)
+            return
         hdr = self._hdr_for(crank)
         self._codecs[crank] = codec
         self._grad_views.pop(crank, None)
@@ -361,6 +480,25 @@ class ParamServer:
 
                 fn = jax.jit(_decode_apply)
             self._apply_cache[codec.name] = fn
+        return fn
+
+    def _sc_apply_for(self, codec: "codec_mod.Codec", size: int) -> Callable:
+        """The jitted decode+apply for one (codec, shard size) — the
+        per-slot analog of :meth:`_apply_for` (frame layouts are a pure
+        function of (codec, n), so the cache key carries both)."""
+        key = (codec.name, size)
+        fn = self._sc_apply_cache.get(key)
+        if fn is None:
+            rule_apply = self.rule.apply
+            if codec.identity:
+                fn = jax.jit(rule_apply)
+            else:
+                def _decode_apply(param, parts, state):
+                    return rule_apply(param, codec.decode_parts(parts, size),
+                                      state)
+
+                fn = jax.jit(_decode_apply)
+            self._sc_apply_cache[key] = fn
         return fn
 
     def _push_staging(self, crank: int) -> np.ndarray:
@@ -664,6 +802,394 @@ class ParamServer:
                 )
             span.end("applied")
 
+    # -- shardctl services: shard-addressed ops over the versioned map -------
+
+    def _sc_verdict(self, sid: int) -> int:
+        """Route an op addressing shard ``sid``: OK to serve, NACK_MAP
+        when the map says someone else owns it (the reply carries our
+        newer map), BUSY while its state is frozen or in flight to us."""
+        try:
+            owner = self.smap.owner(sid) if self.smap is not None else -1
+        except KeyError:
+            owner = -1
+        if owner != self.rank:
+            return _scwire.NACK_MAP
+        slot = self._slots.get(sid)
+        if slot is None or slot.frozen:
+            return _scwire.BUSY
+        return _scwire.OK
+
+    def _sc_ops_counter(self, sid: int):
+        return self.metrics.counter("mpit_shardctl_shard_ops_total",
+                                    rank=self.rank, shard=sid)
+
+    def _sc_busy_timer(self, sid: int):
+        """Busy-seconds timer for one slot (clock lives in obs — the
+        MT-O4xx contract).  Spans dedup→apply→ack-complete, cooperative
+        suspensions included: that *is* the time the shard's service
+        occupied, which is what the rebalance policy weighs."""
+        return self.metrics.timer("mpit_shardctl_shard_busy_seconds",
+                                  rank=self.rank, shard=sid)
+
+    def _sc_recv_grad(self, crank: int, gen: int = 0):
+        """Shardctl GRAD loop: alloc-receive the shard-addressed frame,
+        route by map, dedup on the *slot's* table (it migrates with the
+        shard — at-most-once holds across owners), decode+apply in one
+        jitted call, status-ack."""
+        codec = self._codecs.get(crank)
+        if codec is None:
+            return
+        while self.live.on:
+            raw = yield from aio_recv(
+                self.transport, crank, tags.GRAD, live=self.live,
+                abort=self._svc_abort(crank, gen),
+            )
+            if raw is None:
+                return
+            buf = np.frombuffer(raw, np.uint8)
+            epoch, seq, _mapver, sid = _scwire.unpack_sc_header(buf)
+            span = self._spans.op("GRAD", peer=crank, side="server")
+            span.note(epoch=epoch, seq=seq, shard=sid)
+            self.leases.renew(crank, epoch)
+            verdict = self._sc_verdict(sid)
+            if verdict != _scwire.OK:
+                (self._m_sc_nacks if verdict == _scwire.NACK_MAP
+                 else self._m_sc_busy).inc()
+                span.mark("ack")
+                yield from aio_send(
+                    self.transport,
+                    _scwire.reply_frame(epoch, seq, verdict, sid,
+                                        body=self.smap.to_wire()),
+                    crank, tags.GRAD_ACK, live=self.live,
+                    abort=self._svc_abort(crank, gen),
+                )
+                span.end("nack" if verdict == _scwire.NACK_MAP else "busy")
+                continue
+            slot = self._slots[sid]
+            with self._sc_busy_timer(sid):
+                admitted = slot.dedup.admit(crank, tags.GRAD, epoch, seq)
+                if admitted == STALE:
+                    self._m_stale.inc()
+                    span.end("stale")
+                    continue
+                if admitted == DUP:
+                    self._m_dups.inc()
+                    span.mark("ack")
+                    yield from aio_send(
+                        self.transport,
+                        _scwire.reply_frame(epoch, seq, _scwire.OK, sid),
+                        crank, tags.GRAD_ACK, live=self.live,
+                        abort=self._svc_abort(crank, gen),
+                    )
+                    span.end("dup")
+                    continue
+                span.mark("apply")
+                body = buf[_scwire.SC_HDR_BYTES:]
+                apply_fn = self._sc_apply_for(codec, slot.size)
+                with self._dev_ctx():
+                    if codec.identity:
+                        grad_in: Any = jnp.asarray(body.view(self.dtype))
+                    else:
+                        grad_in = [jnp.asarray(v) for v in
+                                   codec.split_wire(body, slot.size)]
+                    slot.param, slot.rule_state = apply_fn(
+                        slot.param, grad_in, slot.rule_state)
+                slot.committed()
+                slot.grads_applied += 1
+                self._m_grads.inc()
+                self._sc_ops_counter(sid).inc()
+                if not self.live.on:
+                    span.end("aborted")
+                    continue
+                span.mark("ack")
+                yield from aio_send(
+                    self.transport,
+                    _scwire.reply_frame(epoch, seq, _scwire.OK, sid),
+                    crank, tags.GRAD_ACK, live=self.live,
+                    abort=self._svc_abort(crank, gen),
+                )
+            span.end("applied")
+
+    def _sc_send_param(self, crank: int, gen: int = 0):
+        """Shardctl read loop: fixed 32-byte PARAM_REQ header in, the
+        slot's cached snapshot frame (or a NACK/BUSY status) out."""
+        codec = self._codecs.get(crank)
+        if codec is None:
+            return
+        req = self._req_buf[crank]
+        while self.live.on:
+            got = yield from aio_recv(
+                self.transport, crank, tags.PARAM_REQ, live=self.live,
+                out=req, abort=self._svc_abort(crank, gen),
+            )
+            if got is None:
+                return
+            if not self.live.io:
+                continue
+            epoch, seq, _mapver, sid = (int(x) for x in req)
+            span = self._spans.op("PARAM", peer=crank, side="server")
+            span.note(epoch=epoch, seq=seq, shard=sid)
+            if epoch < self.leases.epoch(crank):
+                self._m_stale.inc()  # dead incarnation's request
+                span.end("stale")
+                continue
+            self.leases.renew(crank, epoch)
+            verdict = self._sc_verdict(sid)
+            if verdict != _scwire.OK:
+                (self._m_sc_nacks if verdict == _scwire.NACK_MAP
+                 else self._m_sc_busy).inc()
+                span.mark("send")
+                yield from aio_send(
+                    self.transport,
+                    _scwire.reply_frame(epoch, seq, verdict, sid,
+                                        body=self.smap.to_wire()),
+                    crank, tags.PARAM, live=self.live,
+                    abort=self._svc_abort(crank, gen),
+                )
+                span.end("nack" if verdict == _scwire.NACK_MAP else "busy")
+                continue
+            slot = self._slots[sid]
+            with self._sc_busy_timer(sid):
+                span.mark("snapshot")
+                frame, hit = slot.snapshot_wire(codec)
+                (self._m_snap_hits if hit else self._m_snap_copies).inc()
+                reply = _scwire.reply_frame(epoch, seq, _scwire.OK, sid,
+                                            body=frame)
+                span.mark("send")
+                yield from aio_send(
+                    self.transport, reply, crank, tags.PARAM,
+                    live=self.live, abort=self._svc_abort(crank, gen),
+                )
+                self._m_served.inc()
+                self._sc_ops_counter(sid).inc()
+            span.end("served")
+
+    def _sc_recv_push(self, crank: int, gen: int = 0):
+        """Shardctl PARAM_PUSH loop (seeding and whole-shard writes):
+        dedup-admitted per slot, decoded host-side, one h2d per write."""
+        codec = self._codecs.get(crank)
+        if codec is None:
+            return
+        while self.live.on:
+            raw = yield from aio_recv(
+                self.transport, crank, tags.PARAM_PUSH, live=self.live,
+                abort=self._svc_abort(crank, gen),
+            )
+            if raw is None:
+                return
+            buf = np.frombuffer(raw, np.uint8)
+            epoch, seq, _mapver, sid = _scwire.unpack_sc_header(buf)
+            span = self._spans.op("PARAM_PUSH", peer=crank, side="server")
+            span.note(epoch=epoch, seq=seq, shard=sid)
+            self.leases.renew(crank, epoch)
+            verdict = self._sc_verdict(sid)
+            if verdict != _scwire.OK:
+                (self._m_sc_nacks if verdict == _scwire.NACK_MAP
+                 else self._m_sc_busy).inc()
+                span.mark("ack")
+                yield from aio_send(
+                    self.transport,
+                    _scwire.reply_frame(epoch, seq, verdict, sid,
+                                        body=self.smap.to_wire()),
+                    crank, tags.PARAM_PUSH_ACK, live=self.live,
+                    abort=self._svc_abort(crank, gen),
+                )
+                span.end("nack" if verdict == _scwire.NACK_MAP else "busy")
+                continue
+            slot = self._slots[sid]
+            with self._sc_busy_timer(sid):
+                admitted = slot.dedup.admit(crank, tags.PARAM_PUSH, epoch,
+                                            seq)
+                if admitted == STALE:
+                    self._m_stale.inc()
+                    span.end("stale")
+                    continue
+                if admitted != DUP:
+                    span.mark("apply")
+                    body = buf[_scwire.SC_HDR_BYTES:]
+                    if codec.identity:
+                        host: Any = body.view(self.dtype)
+                    else:
+                        host = np.empty(slot.size, np.float32)
+                        codec.decode_into(body, host)
+                    with self._dev_ctx():
+                        slot.param = jnp.asarray(host)
+                    slot.committed()
+                    self._sc_ops_counter(sid).inc()
+                else:
+                    self._m_dups.inc()
+                span.mark("ack")
+                yield from aio_send(
+                    self.transport,
+                    _scwire.reply_frame(epoch, seq, _scwire.OK, sid),
+                    crank, tags.PARAM_PUSH_ACK, live=self.live,
+                    abort=self._svc_abort(crank, gen),
+                )
+            span.end("dup" if admitted == DUP else "applied")
+
+    # -- shardctl control plane: directives, migration, beats ----------------
+
+    def _sc_live_abort(self) -> Callable[[], bool]:
+        return lambda: not self.live.on
+
+    def _sc_map_listener(self):
+        """Perpetual MAP_UPDATE service (controller channel): INSTALL
+        adopts a map; RELEASE/ACQUIRE run the live-migration handshake;
+        ADOPT restores a dead peer's shard from its checkpoint."""
+        while self.live.on:
+            raw = yield from aio_recv(
+                self.transport, self.controller_rank, tags.MAP_UPDATE,
+                live=self.live, abort=self._sc_live_abort(),
+            )
+            if raw is None:
+                return
+            kind, sid, peer, smap = _scwire.parse_map_update(bytes(raw))
+            if kind == _scwire.RELEASE:
+                yield from self._sc_release(sid, peer, smap)
+            elif kind == _scwire.ACQUIRE:
+                yield from self._sc_acquire(sid, peer, smap)
+            elif kind == _scwire.ADOPT:
+                yield from self._sc_adopt(sid, peer, smap)
+            else:
+                self._sc_install_map(smap)
+
+    def _sc_release(self, sid: int, dst: int, new_map: ShardMap):
+        """Source side of a live migration: flip to the new map first
+        (every later op for the shard drains via NACK_MAP), freeze the
+        slot, serve exactly one SHARD_PULL, ship the state, drop it."""
+        span = self._spans.op("MIGRATE", peer=dst, side="server")
+        span.note(shard=sid, direction="out")
+        slot = self._slots.get(sid)
+        if slot is None:
+            self.log.warning(
+                "RELEASE for shard %d but this server does not hold it "
+                "(raced directive?) — ignoring", sid)
+            span.end("aborted")
+            return
+        self._sc_install_map(new_map)
+        slot.frozen = True
+        span.mark("freeze")
+        deadline = deadline_at(_scmigrate.SC_DEADLINE_S)
+        buf = np.zeros(1, np.int64)
+        got = yield from aio_recv(self.transport, dst, tags.SHARD_PULL,
+                                  live=self.live, out=buf,
+                                  deadline=deadline)
+        if got is None:
+            span.end("aborted")
+            return
+        span.mark("snapshot")
+        msgs = _scmigrate.pack_shard_state(slot)
+        span.mark("send")
+        for msg in msgs:
+            yield from aio_send(self.transport, msg, dst, tags.SHARD_STATE,
+                                live=self.live, deadline=deadline)
+        del self._slots[sid]
+        self._m_sc_owned.set(len(self._slots))
+        self._m_sc_out.inc()
+        self.log.info("released shard %d to server %d (map v%d)",
+                      sid, dst, new_map.version)
+        span.end("released")
+
+    def _sc_acquire(self, sid: int, src: int, new_map: ShardMap):
+        """Destination side: adopt the map, pull the frozen state, place
+        it on this server's backend, echo DONE to the controller."""
+        span = self._spans.op("MIGRATE", peer=src, side="server")
+        span.note(shard=sid, direction="in")
+        self._sc_install_map(new_map)
+        deadline = deadline_at(_scmigrate.SC_DEADLINE_S)
+        span.mark("pull")
+        yield from aio_send(self.transport, np.asarray([sid], np.int64),
+                            src, tags.SHARD_PULL, live=self.live,
+                            deadline=deadline)
+        slot = yield from _scmigrate.recv_shard_state(
+            self.transport, src, self.live, deadline=deadline)
+        if slot is None:
+            span.end("aborted")
+            return
+        span.mark("install")
+        with self._dev_ctx():
+            slot.param = jnp.asarray(slot.param)
+            if slot.rule_state:
+                slot.rule_state = {k: jnp.asarray(v)
+                                   for k, v in slot.rule_state.items()}
+            else:
+                slot.rule_state = self.rule.init(slot.param)
+        self._slots[sid] = slot
+        self._m_sc_owned.set(len(self._slots))
+        self._m_sc_in.inc()
+        span.mark("ack")
+        yield from aio_send(
+            self.transport,
+            _scwire.map_update(_scwire.DONE, sid, self.rank, self.smap),
+            self.controller_rank, tags.MAP_UPDATE, live=self.live,
+            deadline=deadline)
+        self.log.info("acquired shard %d from server %d (map v%d)",
+                      sid, src, new_map.version)
+        span.end("acquired")
+
+    def _sc_adopt(self, sid: int, dead: int, new_map: ShardMap):
+        """Failover: the previous owner is gone — restore the shard from
+        its latest checkpoint (shard<id>_latest.npz) and serve it.  Ops
+        the dead server applied-and-checkpointed dedup as DUP; ops after
+        its last checkpoint are still unacked client-side and re-apply
+        exactly once (the checkpoint is the consistency cut, §6.3)."""
+        span = self._spans.op("MIGRATE", peer=dead, side="server")
+        span.note(shard=sid, direction="adopt")
+        self._sc_install_map(new_map)
+        if not self._ckpt_dir:
+            span.end("exhausted")
+            raise RuntimeError(
+                f"ADOPT shard {sid}: server {self.rank} has no ckpt_dir — "
+                "failover needs shard checkpoints")
+        span.mark("restore")
+        slot = _scmigrate.load_shard_state(self._ckpt_dir, sid)
+        with self._dev_ctx():
+            slot.param = jnp.asarray(slot.param)
+            if slot.rule_state:
+                slot.rule_state = {k: jnp.asarray(v)
+                                   for k, v in slot.rule_state.items()}
+            else:
+                slot.rule_state = self.rule.init(slot.param)
+        self._slots[sid] = slot
+        self._m_sc_owned.set(len(self._slots))
+        self._m_sc_adopt.inc()
+        span.mark("ack")
+        yield from aio_send(
+            self.transport,
+            _scwire.map_update(_scwire.DONE, sid, self.rank, self.smap),
+            self.controller_rank, tags.MAP_UPDATE, live=self.live,
+            deadline=deadline_at(_scmigrate.SC_DEADLINE_S))
+        self.log.warning("adopted shard %d from dead server %d (map v%d)",
+                         sid, dead, new_map.version)
+        span.end("adopted")
+
+    def _sc_beat(self):
+        """Beat to the controller: liveness plus the per-shard load
+        report (ops and busy-seconds deltas, read from this server's obs
+        instruments) the rebalance policy consumes."""
+        interval = self.ft.heartbeat_s if self.ft.heartbeat_s > 0 else 0.1
+        while self.live.on:
+            if not (yield from aio_sleep(interval, live=self.live)):
+                return
+            self._sc_beat_seq += 1
+            words = [self.ft.epoch, self._sc_beat_seq, len(self._slots)]
+            for sid in sorted(self._slots):
+                ops = int(self._sc_ops_counter(sid).value)
+                busy = float(self.metrics.histogram(
+                    "mpit_shardctl_shard_busy_seconds",
+                    rank=self.rank, shard=sid).total)
+                last_ops, last_busy = self._sc_last_report.get(sid, (0, 0.0))
+                words += [sid, ops - last_ops,
+                          int((busy - last_busy) * 1e6)]
+                self._sc_last_report[sid] = (ops, busy)
+            try:
+                yield from aio_send(
+                    self.transport, np.asarray(words, np.int64),
+                    self.controller_rank, tags.HEARTBEAT, live=self.live,
+                    deadline=deadline_at(4 * interval))
+            except DeadlineExceeded:
+                pass  # best-effort; the next beat tries again
+
     def _recv_heartbeat(self, crank: int, gen: int = 0):
         """Loop: consume HEARTBEAT beacons, renew the client's lease
         (current-epoch beats only — a dead incarnation's leftovers must
@@ -744,6 +1270,19 @@ class ParamServer:
         concurrent loader can always trust."""
         from mpit_tpu.utils.checkpoint import save_server_state
 
+        if self._sc:
+            # Shard-oriented checkpoints: one shard<id>_latest.npz per
+            # owned slot, so failover ADOPTs by shard id regardless of
+            # which server wrote the file (shardctl/migrate.py).
+            if not self._slots:
+                raise RuntimeError(
+                    "server owns no shards to checkpoint (init not run, "
+                    "or every slot migrated away)")
+            path = ""
+            for _sid, slot in sorted(self._slots.items()):
+                path = str(_scmigrate.save_shard_state(
+                    directory, slot, self.rank))
+            return path
         if self.param is None:
             raise RuntimeError("server holds no shard yet (init not run)")
         if self._snap_host is not None and self._snap_host[0] == self._snap_version:
@@ -814,7 +1353,7 @@ class ParamServer:
                 self.save_state(self._ckpt_dir)
                 self._m_ckpts.inc()
                 next_save = time.monotonic() + self._ckpt_interval
-        if self.param is not None:
+        if self.param is not None or self._slots:
             self.save_state(self._ckpt_dir)  # final state at stop
             self._m_ckpts.inc()
         if self.sched.errors:
@@ -828,6 +1367,17 @@ class ParamServer:
         gen = self._gen[crank]
         self.sched.spawn(self._svc(crank, gen, self._recv_stop),
                          name=f"recv_stop:{crank}.g{gen}")
+        if self._sc:
+            self.sched.spawn(self._svc(crank, gen, self._sc_recv_grad),
+                             name=f"recv_grad:{crank}.g{gen}")
+            self.sched.spawn(self._svc(crank, gen, self._sc_send_param),
+                             name=f"send_param:{crank}.g{gen}")
+            self.sched.spawn(self._svc(crank, gen, self._sc_recv_push),
+                             name=f"recv_param:{crank}.g{gen}")
+            if self._hb.get(crank):
+                self.sched.spawn(self._svc(crank, gen, self._recv_heartbeat),
+                                 name=f"recv_heartbeat:{crank}.g{gen}")
+            return
         self.sched.spawn(self._svc(crank, gen, self._recv_grad),
                          name=f"recv_grad:{crank}.g{gen}")
         self.sched.spawn(self._svc(crank, gen, self._send_param),
@@ -861,9 +1411,11 @@ class ParamServer:
         self.sched.wait()
         # Phase 2: parameter seeding from the first client only
         # (init once & only once, reference README:64-67) — skipped on
-        # resume, where the checkpoint already seeded the shard.
+        # resume, where the checkpoint already seeded the shard, and in
+        # shardctl mode, where seeding arrives as ordinary dedup'd
+        # PARAM_PUSH ops into the perpetual per-slot push service.
         seeder = self.cranks[0]
-        if not self._restored:
+        if not self._restored and not self._sc:
             self.sched.spawn(self._svc(seeder, 0, self._recv_param, once=True),
                              name="seed_param")
             self.sched.wait()
@@ -887,6 +1439,9 @@ class ParamServer:
                                  name=f"init_listener:{crank}")
         if self.ft.lease_ttl_s > 0:
             self.sched.spawn(self._lease_reaper(), name="lease_reaper")
+        if self._sc and self.controller_rank is not None:
+            self.sched.spawn(self._sc_map_listener(), name="sc_map_listener")
+            self.sched.spawn(self._sc_beat(), name="sc_beat")
         if self._ckpt_dir:
             self._serve_with_checkpoints()
         else:

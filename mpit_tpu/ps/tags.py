@@ -30,6 +30,38 @@ PARAM_PUSH_ACK = 7  # server -> client: ack after the write lands — 0-byte
 STOP = 8  # client -> server: 0-byte graceful-shutdown signal
 HEARTBEAT = 9  # client -> server: int64 [epoch, seq] liveness beacon; the
 #                server's lease registry (mpit_tpu/ft/leases.py) renews
-#                the client's lease on every beat and evicts on expiry
+#                the client's lease on every beat and evicts on expiry.
+#                Under shardctl, servers also beat to the controller with
+#                a per-shard load report appended (docs/PROTOCOL.md §7.4).
+MAP_UPDATE = 10  # controller -> server/client (and server -> controller
+#                  as the DONE echo): a shard-map directive
+#                  [kind, shard_id, peer] + serialized ShardMap
+#                  (mpit_tpu/shardctl/wire.py; docs/PROTOCOL.md §7.3)
+SHARD_PULL = 11  # server(dst) -> server(src): int64 [shard_id] — "I was
+#                  directed to acquire this shard; send its state"
+SHARD_STATE = 12  # server(src) -> server(dst): the frozen shard's full
+#                   state (meta json + param bytes + rule-state arrays),
+#                   a multi-message sequence on this one FIFO channel
 
 EMPTY = b""  # the canonical 0-byte payload
+
+# Protocol-conformance pairing table (machine-checked: mtlint MT-P5xx).
+# Every tag above MUST have an entry naming its sender and receiver
+# roles; client<->server rows are additionally cross-checked against the
+# actual role-file call sites (MT-P102), while rows involving the
+# controller or server<->server traffic are exempt from that binary
+# role model and are validated against this table + docs/PROTOCOL.md.
+TAG_PAIRS = {
+    "INIT": ("client", "server"),
+    "GRAD": ("client", "server"),
+    "GRAD_ACK": ("server", "client"),
+    "PARAM_REQ": ("client", "server"),
+    "PARAM": ("server", "client"),
+    "PARAM_PUSH": ("client", "server"),
+    "PARAM_PUSH_ACK": ("server", "client"),
+    "STOP": ("client", "server|controller"),
+    "HEARTBEAT": ("client|server", "server|controller"),
+    "MAP_UPDATE": ("controller|server", "server|client|controller"),
+    "SHARD_PULL": ("server", "server"),
+    "SHARD_STATE": ("server", "server"),
+}

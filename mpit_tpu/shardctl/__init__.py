@@ -1,0 +1,64 @@
+"""mpit_tpu.shardctl — versioned shard maps, load-aware rebalancing,
+and live shard migration for the PS gang.
+
+The seed protocol freezes placement at INIT: equal contiguous shards,
+one per server rank, for the life of the run.  That static layout is
+the scalability ceiling the related work keeps measuring — a single
+slow or hot server gates every client (imbalanced-arrival skew, arxiv
+1804.05349), and an evicted server's shard is unrecoverable without a
+same-rank restart.  This package makes placement a first-class, mutable
+object and threads it through ps/comm/ft/obs/train:
+
+- :mod:`shardmap` — a versioned :class:`ShardMap` (monotonic
+  ``version``, shard→server assignment, unequal/weighted shards)
+  replacing the raw ``shard_layout()`` call sites.
+- :mod:`wire` — shard-addressed op headers ``[epoch, seq, map_version,
+  shard_id]``, status replies (OK / NACK_MAP / BUSY), INIT v4, and
+  MAP_UPDATE directives.
+- :mod:`migrate` — the live migration state machine's data plane:
+  per-slot server state (param + optimizer + shard-scoped dedup +
+  snapshot cache), the SHARD_PULL/SHARD_STATE transfer, and
+  shard-oriented checkpoints for failover.
+- :mod:`policy` / :mod:`controller` — the control plane: a lease
+  registry over *servers* (PR 3's machinery pointed the other way), a
+  load-aware :class:`RebalancePolicy` consuming per-shard busy reports
+  (PR 4's obs instruments), and the :class:`ShardController` that
+  executes migrations and failovers and distributes committed maps.
+
+Correctness invariants (tested in tests/test_shardctl.py): live
+migration and lease-expiry failover both leave final params **bitwise
+equal** to a fault-free static-map run, including under drop/dup fault
+plans — the shard-scoped dedup state travels with the shard, so a
+retried op admits at-most-once across owners.
+"""
+
+from mpit_tpu.shardctl.controller import ShardController
+from mpit_tpu.shardctl.migrate import (
+    SC_DEADLINE_S,
+    ShardSlot,
+    load_shard_state,
+    save_shard_state,
+)
+from mpit_tpu.shardctl.policy import RebalancePolicy, ShardLoad
+from mpit_tpu.shardctl.shardmap import ShardEntry, ShardMap
+from mpit_tpu.shardctl.wire import (
+    ACQUIRE,
+    ADOPT,
+    BUSY,
+    DONE,
+    FLAG_SHARDCTL,
+    INSTALL,
+    NACK_MAP,
+    OK,
+    RELEASE,
+    SC_HDR_BYTES,
+)
+
+__all__ = [
+    "ShardController", "ShardSlot", "ShardMap", "ShardEntry",
+    "RebalancePolicy", "ShardLoad",
+    "save_shard_state", "load_shard_state",
+    "SC_DEADLINE_S", "SC_HDR_BYTES", "FLAG_SHARDCTL",
+    "OK", "NACK_MAP", "BUSY",
+    "INSTALL", "RELEASE", "ACQUIRE", "ADOPT", "DONE",
+]

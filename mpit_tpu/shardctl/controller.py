@@ -1,0 +1,293 @@
+"""The shard-map control plane: one controller rank per gang.
+
+The controller owns the authoritative :class:`ShardMap` and is the only
+writer of new versions.  Everything it knows arrives over the existing
+transport fabric — server beats (HEARTBEAT frames carrying per-shard
+load reports), directive echoes (MAP_UPDATE/DONE), and client STOPs —
+so it deploys exactly like any other rank: in-process for tests, a gang
+child in the process launcher, its own host over TCP.
+
+Three responsibilities:
+
+- **liveness of servers** — the PR 3 lease machinery pointed the other
+  way: a :class:`~mpit_tpu.ft.leases.LeaseRegistry` over *server* ranks,
+  renewed by their beats.  Expiry triggers **shard failover**: the dead
+  server's shards are reassigned to survivors, each of which ADOPTs the
+  shard from its latest checkpoint — the gang keeps training instead of
+  wedging or waiting for a same-rank restart.
+- **load-aware rebalancing** — beats carry per-shard busy-seconds
+  deltas (from the servers' obs instruments); the
+  :class:`~mpit_tpu.shardctl.policy.RebalancePolicy` turns a window of
+  them into at most one migration proposal, executed via the live
+  RELEASE/ACQUIRE handshake (docs/PROTOCOL.md §7.3).
+- **map distribution** — after any flip the new map is broadcast
+  (MAP_UPDATE/INSTALL) to every client and surviving server.  Broadcast
+  is an optimization; the NACK_MAP path is the correctness mechanism.
+
+Determinism for tests: the clock is injected (lease expiry and policy
+windows can be driven by a fake clock), ``pump()`` does one bounded
+scan with no sleeps, and ``migrate()``/``failover()`` are synchronous
+methods a test can call directly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+import numpy as np
+
+from mpit_tpu.aio import LiveFlag, Scheduler, aio_recv, aio_send, deadline_at
+from mpit_tpu.ft import LeaseRegistry
+from mpit_tpu.obs import registry_or_local
+from mpit_tpu.ps import tags
+from mpit_tpu.shardctl.migrate import SC_DEADLINE_S
+from mpit_tpu.shardctl.policy import RebalancePolicy, ShardLoad
+from mpit_tpu.shardctl.shardmap import ShardMap
+from mpit_tpu.shardctl.wire import (
+    ACQUIRE,
+    ADOPT,
+    DONE,
+    INSTALL,
+    RELEASE,
+    map_update,
+    parse_map_update,
+)
+from mpit_tpu.utils.logging import get_logger
+
+
+class ShardController:
+    def __init__(
+        self,
+        rank: int,
+        transport,
+        server_ranks: List[int],
+        client_ranks: List[int],
+        smap: Optional[ShardMap] = None,
+        policy: Optional[RebalancePolicy] = None,
+        lease_ttl_s: float = 0.0,
+        op_deadline_s: float = SC_DEADLINE_S,
+        scheduler: Optional[Scheduler] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rank = rank
+        self.transport = transport
+        self.sranks = list(server_ranks)
+        self.cranks = list(client_ranks)
+        self.smap = smap
+        self.policy = policy or RebalancePolicy()
+        self.sched = scheduler or Scheduler()
+        self.live = LiveFlag()
+        self.log = get_logger("shardctl", rank)
+        self._deadline_s = float(op_deadline_s)
+        self._clock = clock
+        self.leases = LeaseRegistry(self.sranks, ttl_s=lease_ttl_s,
+                                    clock=clock)
+        for srank in self.sranks:
+            self.leases.arm(srank, 0, heartbeats=True)
+        self._dead: Set[int] = set()
+        self._stopped: Set[int] = set()
+        #: current-window loads: server -> shard -> ShardLoad
+        self._window: Dict[int, Dict[int, ShardLoad]] = {}
+        self._window_t0 = clock()
+        self._last_move_t = -1e18
+        self.metrics = registry_or_local()
+        _m, _r = self.metrics, rank
+        self._m_beats = _m.counter("mpit_shardctl_beats_seen_total", rank=_r)
+        self._m_rebal = _m.counter("mpit_shardctl_rebalances_total", rank=_r)
+        self._m_fail = _m.counter("mpit_shardctl_failovers_total", rank=_r)
+        self._m_ver = _m.gauge("mpit_shardctl_map_version", rank=_r)
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _run(self, gen, name: str):
+        task = self.sched.spawn(gen, name=name)
+        return self.sched.wait_for(task)
+
+    def _send(self, payload, dst: int, tag: int, name: str) -> None:
+        self._run(
+            aio_send(self.transport, payload, dst, tag, live=self.live,
+                     deadline=deadline_at(self._deadline_s)),
+            name=name,
+        )
+
+    def _install(self, smap: ShardMap) -> None:
+        if self.smap is None or smap.version > self.smap.version:
+            self.smap = smap
+            self._m_ver.set(smap.version)
+
+    def _broadcast(self, exclude: Set[int] = frozenset()) -> None:
+        """Push the committed map to every client and live server."""
+        frame = map_update(INSTALL, -1, -1, self.smap)
+        for dst in self.cranks + [s for s in self.sranks
+                                  if s not in self._dead]:
+            if dst not in exclude:
+                self._send(frame, dst, tags.MAP_UPDATE, f"bcast:{dst}")
+
+    def _await_done(self, peer: int, shard_id: int) -> None:
+        """Consume MAP_UPDATE messages from ``peer`` until the DONE echo
+        for ``shard_id`` arrives (deadline-bounded, fail loud)."""
+        def _wait():
+            while True:
+                payload = yield from aio_recv(
+                    self.transport, peer, tags.MAP_UPDATE, live=self.live,
+                    deadline=deadline_at(self._deadline_s),
+                )
+                if payload is None:
+                    return None
+                kind, sid, _rank, smap = parse_map_update(payload)
+                if kind == DONE and sid == shard_id:
+                    return smap
+
+        smap = self._run(_wait(), name=f"await_done:{peer}:{shard_id}")
+        if smap is not None:
+            self._install(smap)
+
+    # -- migration / failover (synchronous, deadline-bounded) ---------------
+
+    def migrate(self, shard_id: int, dst: int) -> bool:
+        """Live-migrate ``shard_id`` to server ``dst``: RELEASE to the
+        current owner, ACQUIRE to ``dst``, await the DONE echo, then
+        broadcast the committed map.  Returns False for no-ops (already
+        there, unknown shard, dead destination)."""
+        if self.smap is None or dst in self._dead:
+            return False
+        try:
+            src = self.smap.owner(shard_id)
+        except KeyError:
+            return False
+        if src == dst:
+            return False
+        new_map = self.smap.moved(shard_id, dst)
+        self.log.info("migrating shard %d: server %d -> %d (map v%d)",
+                      shard_id, src, dst, new_map.version)
+        self._send(map_update(RELEASE, shard_id, dst, new_map), src,
+                   tags.MAP_UPDATE, f"release:{src}")
+        self._send(map_update(ACQUIRE, shard_id, src, new_map), dst,
+                   tags.MAP_UPDATE, f"acquire:{dst}")
+        self._await_done(dst, shard_id)
+        self._install(new_map)
+        self._m_rebal.inc()
+        self._last_move_t = self._clock()
+        self._broadcast(exclude={src, dst})
+        return True
+
+    def failover(self, dead_rank: int) -> bool:
+        """Reassign every shard owned by ``dead_rank`` to survivors,
+        each ADOPTing from its latest shard checkpoint."""
+        if self.smap is None or dead_rank in self._dead:
+            return False
+        self._dead.add(dead_rank)
+        survivors = [s for s in self.sranks if s not in self._dead]
+        moved = [e.shard_id for e in self.smap.shards_of(dead_rank)]
+        if not survivors or not moved:
+            return False
+        new_map = self.smap.reassigned(dead_rank, survivors)
+        self.log.warning(
+            "server %d lease expired: failing over shard(s) %s to %s "
+            "(map v%d)", dead_rank, moved,
+            {s: new_map.owner(s) for s in moved}, new_map.version)
+        for sid in moved:
+            owner = new_map.owner(sid)
+            self._send(map_update(ADOPT, sid, dead_rank, new_map), owner,
+                       tags.MAP_UPDATE, f"adopt:{owner}")
+        for sid in moved:
+            self._await_done(new_map.owner(sid), sid)
+        self._install(new_map)
+        self._m_fail.inc()
+        self._last_move_t = self._clock()
+        self._broadcast()
+        return True
+
+    # -- the periodic scan ---------------------------------------------------
+
+    def _drain_beats(self) -> None:
+        for srank in self.sranks:
+            while self.transport.iprobe(srank, tags.HEARTBEAT):
+                handle = self.transport.irecv(srank, tags.HEARTBEAT)
+                while not self.transport.test(handle):
+                    pass  # message fully assembled (iprobe contract)
+                words = np.frombuffer(bytes(self.transport.payload(handle)),
+                                      np.int64)
+                self._m_beats.inc()
+                self.leases.renew(srank, int(words[0]))
+                shards = self._window.setdefault(srank, {})
+                nslots = int(words[2]) if words.size >= 3 else 0
+                for i in range(nslots):
+                    sid, ops, busy_us = (int(x)
+                                         for x in words[3 + 3 * i: 6 + 3 * i])
+                    load = shards.setdefault(sid, ShardLoad())
+                    load.ops += ops
+                    load.busy_s += busy_us / 1e6
+
+
+    def _drain_control(self) -> None:
+        """Client-origin traffic: initial map installs and STOPs."""
+        for crank in self.cranks:
+            while self.transport.iprobe(crank, tags.MAP_UPDATE):
+                handle = self.transport.irecv(crank, tags.MAP_UPDATE)
+                while not self.transport.test(handle):
+                    pass
+                _k, _s, _p, smap = parse_map_update(
+                    bytes(self.transport.payload(handle)))
+                self._install(smap)
+            if crank not in self._stopped and \
+                    self.transport.iprobe(crank, tags.STOP):
+                handle = self.transport.irecv(crank, tags.STOP)
+                while not self.transport.test(handle):
+                    pass
+                self._stopped.add(crank)
+
+    def check_leases(self) -> None:
+        for srank in self.leases.expired():
+            self.leases.evict(srank)
+            self.failover(srank)
+
+    def maybe_rebalance(self) -> bool:
+        """Close the current load window and act on the policy."""
+        now = self._clock()
+        if now - self._window_t0 < self.policy.cooldown_s:
+            return False
+        if now - self._last_move_t < self.policy.cooldown_s:
+            self._window.clear()
+            self._window_t0 = now
+            return False
+        proposal = (self.policy.propose(self.smap, self._window)
+                    if self.smap is not None else None)
+        self._window = {}
+        self._window_t0 = now
+        if proposal is None:
+            return False
+        shard_id, dst = proposal
+        return self.migrate(shard_id, dst)
+
+    def pump(self) -> None:
+        """One bounded control scan (no sleeps): beats, client traffic,
+        lease expiry, at most one rebalance."""
+        self._drain_beats()
+        self._drain_control()
+        self.check_leases()
+        self.maybe_rebalance()
+
+    @property
+    def done(self) -> bool:
+        """Every client stopped — the controller's exit condition."""
+        return len(self._stopped) == len(self.cranks)
+
+    def serve(self, poll_s: float = 0.01, timeout: Optional[float] = None) -> None:
+        """Run the control loop until every client STOPs (the gang-child
+        entry).  ``timeout`` bounds the loop for harness use."""
+        t_end = None if timeout is None else self._clock() + timeout
+        while self.live.on and not self.done:
+            self.pump()
+            if t_end is not None and self._clock() > t_end:
+                raise TimeoutError(
+                    f"shard controller timed out; stopped={sorted(self._stopped)}"
+                    f" of clients={self.cranks}")
+            time.sleep(poll_s)
+        self.log.info("controller done: map v%s, %d rebalances, %d failovers",
+                      getattr(self.smap, "version", None),
+                      int(self._m_rebal.value), int(self._m_fail.value))
+
+    def stop(self) -> None:
+        self.live.stop()

@@ -77,6 +77,17 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     ft_op_deadline_s=0.0,
     ft_max_retries=8,
     supervise=0,
+    # shardctl (mpit_tpu.shardctl): the LAST rank becomes the shard-map
+    # controller (the rest split into servers/clients as usual), clients
+    # address shards through a versioned map, and the controller
+    # rebalances hot shards / fails over a dead server's shards from its
+    # checkpoints.  Requires ft_op_deadline_s > 0 (re-routing rides the
+    # retry machinery).  shardctl_ratio tunes the rebalance trigger;
+    # shardctl_lease_ttl_s > 0 arms server leases at the controller
+    # (expiry => shard failover; pair with server_ckpt_dir).
+    shardctl=False,
+    shardctl_ratio=3.0,
+    shardctl_lease_ttl_s=0.0,
 )
 
 
@@ -153,10 +164,40 @@ def run_rank(
         trainer = MnistTrainer(cfg, pclient=None, data=data, rank=rank)
         return {"role": "local", **trainer.run()}
 
+    sc_on = bool(cfg.get("shardctl", False))
+    ctl_rank: Optional[int] = None
+    role_size = size
+    if sc_on:
+        if str(cfg.get("tester", "none")) != "none":
+            raise ValueError("shardctl and a tester rank are mutually "
+                             "exclusive for now (both claim an edge rank)")
+        if size < 3:
+            raise ValueError("shardctl needs np >= 3 "
+                             "(>=1 server + >=1 worker + the controller)")
+        if float(cfg.get("ft_op_deadline_s", 0) or 0) <= 0:
+            raise ValueError("shardctl needs --ft_op_deadline_s > 0: map "
+                             "re-routing rides the FT retry machinery")
+        ctl_rank = size - 1
+        role_size = size - 1
     sranks, cranks, tester_rank = assign_roles(
-        size, cfg.get("master_freq", 2), cfg.get("tester", "none")
+        role_size, cfg.get("master_freq", 2), cfg.get("tester", "none")
     )
     single_mode = str(cfg.opt).endswith("-single")
+    if sc_on and rank == ctl_rank:
+        from mpit_tpu.shardctl import RebalancePolicy, ShardController
+
+        ctl = ShardController(
+            rank, transport, sranks, cranks,
+            policy=RebalancePolicy(ratio=float(cfg.get("shardctl_ratio", 3.0))),
+            lease_ttl_s=float(cfg.get("shardctl_lease_ttl_s", 0) or 0),
+        )
+        ctl.serve()
+        return {
+            "role": "controller",
+            "map_version": getattr(ctl.smap, "version", None),
+            "rebalances": int(ctl._m_rebal.value),
+            "failovers": int(ctl._m_fail.value),
+        }
     if rank == tester_rank:
         from mpit_tpu.train.tester import run_tester
 
@@ -174,6 +215,7 @@ def run_rank(
             ckpt_interval=float(cfg.get("server_ckpt_interval", 30.0)),
             codec=str(cfg.get("codec", "") or "") or None,
             ft=ft,
+            controller_rank=ctl_rank,
         )
         if bool(cfg.get("resume", False)):
             import pathlib
@@ -207,6 +249,8 @@ def run_rank(
         and not bool(cfg.get("resume", False)) and not rejoining,
         codec=str(cfg.get("codec", "") or "") or None,
         ft=ft,
+        shardctl=sc_on,
+        controller_rank=ctl_rank,
     )
     trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
     log.info("worker with servers %s", sranks)
@@ -246,9 +290,12 @@ def device_env_overrides(cfg: Config, size: int) -> Dict[int, Dict[str, str]]:
         # (libtpu holds an exclusive lock) — the tester if present, else
         # the first client; every other rank is forced to CPU.  Multi-chip
         # hosts should pass per-rank visible-device env via launch_gang's
-        # env_overrides instead.
+        # env_overrides instead.  Under shardctl the last rank is the
+        # controller (a pure host role, never the accelerator owner).
+        role_size = size - 1 if bool(cfg.get("shardctl", False)) else size
         sranks, cranks, tester = assign_roles(
-            size, int(cfg.get("master_freq", 2)), str(cfg.get("tester", "none"))
+            role_size, int(cfg.get("master_freq", 2)),
+            str(cfg.get("tester", "none"))
         )
         accel_rank = tester if tester is not None else cranks[0]
         return {

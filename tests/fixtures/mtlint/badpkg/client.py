@@ -21,3 +21,10 @@ def fetch(transport):
     out = yield from aio_recv(transport, 0, tags.REPLY)
     yield from aio_send(transport, b"", 0, tags.REQ)
     return out
+
+
+def emit_rogue(transport, live, deadline):
+    # MT-P501/MT-P502 pairing-table seed: ROGUE flows client -> server
+    # (so MT-P101/P102 stay quiet) but is registered nowhere.
+    yield from aio_send(transport, b"", 0, tags.ROGUE, live=live,
+                        deadline=deadline)

@@ -31,3 +31,8 @@ def timing_report():
     elapsed = time.monotonic() - t0  # MT-O401: hand-rolled elapsed timing
     print("served in", elapsed, work, tw)  # MT-O402: print() reporting
     return elapsed
+
+
+def drain_rogue(transport, live, gone):
+    # Peer side of the MT-P501/MT-P502 seed (keeps the channel paired).
+    yield from aio_recv(transport, 1, tags.ROGUE, live=live, abort=gone)

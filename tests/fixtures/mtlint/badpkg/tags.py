@@ -6,3 +6,14 @@ GRAD_ACK = 3
 REQ = 4
 REPLY = 5
 ORPHAN = 6  # seeded MT-P101: defined, never used by any role
+ROGUE = 7  # seeded MT-P501/MT-P502: used by both roles, registered nowhere
+
+# Conformance pairing table (MT-P5xx): ROGUE is deliberately absent.
+TAG_PAIRS = {
+    "PING": ("client", "server"),
+    "GRAD": ("client", "server"),
+    "GRAD_ACK": ("server", "client"),
+    "REQ": ("client", "server"),
+    "REPLY": ("server", "client"),
+    "ORPHAN": ("client", "server"),
+}

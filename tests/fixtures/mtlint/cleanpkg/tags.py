@@ -4,3 +4,12 @@ GRAD = 1
 GRAD_ACK = 2
 PARAM_REQ = 3
 PARAM = 4
+
+# Conformance pairing table (MT-P5xx): complete, so the clean fixture
+# stays silent.
+TAG_PAIRS = {
+    "GRAD": ("client", "server"),
+    "GRAD_ACK": ("server", "client"),
+    "PARAM_REQ": ("client", "server"),
+    "PARAM": ("server", "client"),
+}

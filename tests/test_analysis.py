@@ -113,6 +113,32 @@ class TestSeededViolations:
         assert [(f.path, f.line) for f in hits] == [("server.py", 32)]
         assert "registry snapshot" in hits[0].message
 
+    def test_unregistered_tag_detected(self, bad):
+        # MT-P501: ROGUE is used by both roles (so MT-P101/P102 stay
+        # quiet) but has no TAG_PAIRS entry.
+        hits = bad.get("MT-P501", [])
+        assert [(f.path, f.line) for f in hits] == [("tags.py", 9)]
+        assert "ROGUE" in hits[0].message and "TAG_PAIRS" in hits[0].message
+
+    def test_undocumented_tag_detected(self, bad):
+        # MT-P502: ROGUE is absent from the fixture's docs/PROTOCOL.md.
+        hits = bad.get("MT-P502", [])
+        assert [(f.path, f.line) for f in hits] == [("tags.py", 9)]
+        assert "PROTOCOL.md" in hits[0].message
+
+    def test_nonbinary_pairs_exempt_from_role_model(self, bad):
+        # The pairing table is what exempts controller / server<->server
+        # tags from MT-P101/P102 — the badpkg table is all-binary, so
+        # its seeded P101/P102 findings must be unaffected (asserted
+        # elsewhere); here: the real tree's shardctl tags lean on it.
+        from mpit_tpu.analysis.protocol import _binary_pair
+
+        assert _binary_pair(None) is True
+        assert _binary_pair(("client", "server")) is True
+        assert _binary_pair(("server", "client")) is True
+        assert _binary_pair(("server", "server")) is False
+        assert _binary_pair(("controller|server", "server|client")) is False
+
 
 def test_clean_fixture_is_silent():
     assert _findings(CLEANPKG) == []
