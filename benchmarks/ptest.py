@@ -73,6 +73,14 @@ HEARTBEAT_SWEEP = os.environ.get("MPIT_BENCH_HEARTBEAT", "") not in ("", "0")
 # exit, off the timed window); what this measures is the per-op span
 # and per-message counter cost.
 OBS_SWEEP = os.environ.get("MPIT_BENCH_OBS", "") not in ("", "0")
+# MPIT_BENCH_STATUS=1: run one extra codec=none shm leg with the live
+# introspection endpoints up (MPIT_OBS_HTTP: obs registry + statusd
+# thread in every gang child) and a parent-side poller hitting rank 0's
+# /metrics throughout the timed window — live serving under load, as a
+# measured column.  The leg joins the codec=none baseline gate, so
+# serving scrapes while moving bytes must hold the captured record.
+STATUS_SWEEP = os.environ.get("MPIT_BENCH_STATUS", "") not in ("", "0")
+STATUS_PORT = int(os.environ.get("MPIT_BENCH_STATUS_PORT", "8390"))
 # MPIT_BENCH_SKEW=1: run the shm leg twice more under an injected
 # straggler — one server's replies are delay-injected (ft/faults.py,
 # MPIT_BENCH_SKEW_POLLS test()-polls per reply) — first with the
@@ -111,14 +119,18 @@ def bench_ici() -> dict:
 
 
 def bench_shm(codec: str = "", heartbeat: bool = False,
-              obs: bool = False, skew_rebalance=None) -> dict:
+              obs: bool = False, skew_rebalance=None,
+              status: bool = False) -> dict:
     """One shm PS push/pull measurement; ``codec`` overrides
     MPIT_PS_CODEC for the gang (read at client/server construction);
     ``heartbeat`` arms client beacons + the server lease registry;
     ``obs`` enables the observability registry + op spans (MPIT_OBS)
-    inside every gang child; ``skew_rebalance`` (None = no skew)
-    delay-injects the last server's replies and runs the gang in
-    shardctl mode with the rebalance policy off (False) or on (True)."""
+    inside every gang child; ``status`` additionally serves the statusd
+    introspection endpoints (MPIT_OBS_HTTP) in every child while a
+    parent poller scrapes rank 0's /metrics throughout the run;
+    ``skew_rebalance`` (None = no skew) delay-injects the last server's
+    replies and runs the gang in shardctl mode with the rebalance policy
+    off (False) or on (True)."""
     import numpy as np
 
     from mpit_tpu.comm import codec as codec_mod
@@ -130,25 +142,31 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
     _log(f"[shm] {NSERVERS} servers + {NCLIENTS} clients, codec "
          f"{codec_name}, heartbeat {'on' if heartbeat else 'off'}, "
          f"obs {'on' if obs else 'off'}, "
+         f"status {'on' if status else 'off'}, "
          + (f"skew rebalance={'on' if skew_rebalance else 'off'}, "
             if skew_rebalance is not None else "")
          + f"payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
 
-    if (heartbeat or obs) and GANG != "procs":
+    if (heartbeat or obs or status) and GANG != "procs":
         raise RuntimeError(
-            "MPIT_BENCH_HEARTBEAT/MPIT_BENCH_OBS need MPIT_BENCH_GANG=procs")
+            "MPIT_BENCH_HEARTBEAT/MPIT_BENCH_OBS/MPIT_BENCH_STATUS need "
+            "MPIT_BENCH_GANG=procs")
     if skew_rebalance is not None and GANG != "procs":
         raise RuntimeError("MPIT_BENCH_SKEW needs MPIT_BENCH_GANG=procs")
+    polls = [0]
     if GANG == "procs":
         runs = [_shm_run_procs(size, heartbeat=heartbeat, obs=obs,
-                               skew_rebalance=skew_rebalance)
+                               skew_rebalance=skew_rebalance,
+                               status_port=STATUS_PORT if status else None,
+                               status_polls=polls)
                 for _ in range(REPS)]
     else:
         runs = [_shm_run_threads(size, heartbeat=heartbeat)
                 for _ in range(REPS)]
     mbs = float(np.median(np.asarray(runs)))
     _log(f"[shm] codec {codec_name} hb={int(heartbeat)} obs={int(obs)} "
-         f"skew={skew_rebalance}: median {mbs:.1f} MB/s over {runs}")
+         f"status={int(status)} skew={skew_rebalance}: "
+         f"median {mbs:.1f} MB/s over {runs}")
     row = {
         "metric": "ps_pushpull_bandwidth_shm",
         "value": round(mbs, 1),
@@ -162,6 +180,9 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
         "clients": NCLIENTS,
         "servers": NSERVERS,
     }
+    if status:
+        row["status"] = 1
+        row["status_polls"] = polls[0]
     if skew_rebalance is not None:
         row["skew"] = 1
         row["rebalance"] = int(bool(skew_rebalance))
@@ -184,13 +205,33 @@ def _ring_bytes(size: int) -> int:
     return max(64 << 20, 2 * peers * shard_bytes + (16 << 20))
 
 
+def _status_poller(port: int, stop, polls) -> None:
+    """Scrape one rank's /metrics until told to stop, counting the
+    successful polls — the 'live serving under load' half of the
+    MPIT_BENCH_STATUS column."""
+    import urllib.request
+
+    while not stop.is_set():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=1) as resp:
+                if resp.status == 200 and resp.read():
+                    polls[0] += 1
+        except OSError:
+            pass  # child still importing jax / already exited
+        stop.wait(0.2)
+
+
 def _shm_run_procs(size: int, heartbeat: bool = False,
-                   obs: bool = False, skew_rebalance=None) -> float:
+                   obs: bool = False, skew_rebalance=None,
+                   status_port=None, status_polls=None) -> float:
     """One timed gang, one OS process per rank: servers run the PS serve
     loop, clients run T rounds of {pull, push, wait} and report their
     round-loop window; aggregate MB/s uses the union of the client
     windows, so child startup (jax import, seeding) is excluded.  Skew
-    mode adds one controller rank and delay-injects the last server."""
+    mode adds one controller rank and delay-injects the last server.
+    ``status_port`` arms statusd endpoints in every child (base+rank)
+    plus the parent-side /metrics poller."""
     import subprocess
     import tempfile
 
@@ -221,11 +262,23 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
             MPIT_OBS="1" if obs else "0",
         )
         env.pop("MPIT_OBS_TRACE", None)  # tracing implies obs; keep A/B clean
+        if status_port is not None:
+            env["MPIT_OBS_HTTP"] = str(status_port)
+        else:
+            env.pop("MPIT_OBS_HTTP", None)  # endpoints imply obs; A/B clean
         with open(log_path, "w") as fh:
             procs.append(subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--gang-child"],
                 env=env, stdout=fh, stderr=subprocess.STDOUT, text=True,
             ))
+    poll_stop, poller = None, None
+    if status_port is not None:
+        poll_stop = threading.Event()
+        local = [0]
+        poller = threading.Thread(
+            target=_status_poller, args=(status_port, poll_stop, local),
+            daemon=True)
+        poller.start()
     deadline = time.monotonic() + float(
         os.environ.get("MPIT_BENCH_GANG_TIMEOUT", "900"))
     try:
@@ -249,6 +302,19 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        if poll_stop is not None:
+            poll_stop.set()
+            poller.join(timeout=5)
+    if status_port is not None:
+        if local[0] == 0:
+            raise RuntimeError(
+                "MPIT_BENCH_STATUS leg completed but the parent poller "
+                "never got a 200 from rank 0's /metrics — the endpoint "
+                "was not live during the run (fake column)")
+        if status_polls is not None:
+            status_polls[0] += local[0]
+        _log(f"[shm] status poller: {local[0]} successful /metrics "
+             f"scrape(s) during the gang")
     windows = []
     for rank in range(NSERVERS, NSERVERS + NCLIENTS):
         with open(result_files[rank]) as fh:
@@ -287,6 +353,13 @@ def _gang_child() -> None:
     ctl_rank = nranks - 1 if skew else None
     size = spec["size"]
     heartbeat = bool(spec.get("heartbeat"))
+    # Live introspection endpoint (no-op unless MPIT_OBS_HTTP rode in
+    # from the parent — the MPIT_BENCH_STATUS column).
+    from mpit_tpu.obs import maybe_start_statusd
+
+    maybe_start_statusd(
+        rank, role=("controller" if rank == ctl_rank
+                    else "server" if rank in sranks else "client"))
     # Explicit FTConfig either way: the A/B must measure the heartbeat
     # machinery, not whatever MPIT_FT_* happens to be in the caller env.
     # Very generous TTL: the sweep measures liveness *cost*, not
@@ -471,6 +544,12 @@ def main():
                            for hb in hb_modes for ob in obs_modes)
         else:
             results.extend(_bench_shm_subprocess(c) for c in sweep)
+    if STATUS_SWEEP and MODE in ("shm", "both"):
+        # Live-serving leg: obs on + statusd endpoints in every child +
+        # a parent poller scraping /metrics throughout.  codec=none, so
+        # the row joins the baseline gate — serving scrapes must not
+        # cost the record.
+        results.append(bench_shm("none", obs=True, status=True))
     if SKEW_SWEEP and MODE in ("shm", "both"):
         # The straggler A/B runs at codec=none (the skew is in the
         # *reply latency*, not the byte volume): rebalance off, then on.

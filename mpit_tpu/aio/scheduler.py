@@ -20,6 +20,7 @@ import time
 from typing import Any, Callable, Generator, Optional
 
 from mpit_tpu.aio.queue import Queue
+from mpit_tpu.obs import flight as _obs_flight
 from mpit_tpu.obs import metrics as _obs_metrics
 from mpit_tpu.obs import spans as _obs_spans
 
@@ -34,6 +35,16 @@ from mpit_tpu.obs import spans as _obs_spans
 # nothing still sleeps; at 4 MB chunks the duty cycle stays far above
 # wire speed.  0 disables.
 IDLE_USEC = float(os.environ.get("MPIT_AIO_IDLE_USEC", "200"))
+
+# Stuck-gang watchdog (obs/flight.py): when a non-empty queue has
+# accumulated this many seconds of idle backoff without completing a
+# single task, the scheduler dumps its live task table plus the flight
+# recorder's recent events — a hang produces a postmortem instead of
+# nothing.  Counted in *idle-backoff* seconds (no extra clock reads on
+# the hot path): a pass that completes a task resets the budget, so a
+# healthy-but-busy gang never trips it.  Active only when obs is
+# enabled; 0 disables.
+STALL_S = float(os.environ.get("MPIT_OBS_STALL_S", "60"))
 
 # Task signals (reference init.lua:21-25).  INIT/OK are retained for state
 # reporting; the scheduler itself only reacts to EXEC (keep going) vs DONE.
@@ -133,7 +144,8 @@ class Scheduler:
     co_ping (init.lua:147-174), ``wait`` = co_wait (init.lua:178-185).
     """
 
-    def __init__(self, idle_usec: Optional[float] = None) -> None:
+    def __init__(self, idle_usec: Optional[float] = None,
+                 stall_s: Optional[float] = None) -> None:
         self.queue: Queue[Task] = Queue()
         self.errors: list[TaskError] = []
         self.idle_usec = IDLE_USEC if idle_usec is None else float(idle_usec)
@@ -142,10 +154,15 @@ class Scheduler:
         # disabled they are the shared null objects, so the per-step and
         # idle accounting below costs one no-op method call.
         self._rec = _obs_spans.get_recorder()
+        self._flight = _obs_flight.get_flight()
+        self.stall_s = STALL_S if stall_s is None else float(stall_s)
+        self._idle_accum = 0.0
+        self._stall_dumped = False
         _reg = _obs_metrics.get_registry()
         self._m_steps = _reg.counter("mpit_aio_steps_total")
         self._m_idle = _reg.counter("mpit_aio_idle_seconds_total")
         self._m_tasks = _reg.counter("mpit_aio_tasks_total")
+        self._m_stalls = _reg.counter("mpit_aio_stall_dumps_total")
 
     # -- co_execute ---------------------------------------------------------
     def spawn(
@@ -186,11 +203,29 @@ class Scheduler:
             if usec > 0:
                 time.sleep(usec * 1e-6)
         progressed = self._completions != done0
-        if self.idle_usec > 0 and self.queue and not progressed:
+        if progressed:
+            self._idle_accum = 0.0
+            self._stall_dumped = False
+        elif self.idle_usec > 0 and self.queue:
             # Full pass, nothing finished: yield the core (see IDLE_USEC)
             # instead of burning it on iprobe spins.
             time.sleep(self.idle_usec * 1e-6)
             self._m_idle.inc(self.idle_usec * 1e-6)
+            self._idle_accum += self.idle_usec * 1e-6
+            if (self._flight.enabled and self.stall_s > 0
+                    and not self._stall_dumped
+                    and self._idle_accum >= self.stall_s):
+                # Stuck gang: nothing completed across stall_s of idle
+                # backoff.  Dump once per stall episode.
+                self._stall_dumped = True
+                self._m_stalls.inc()
+                self._flight.record(
+                    "scheduler_stall", idle_s=self._idle_accum,
+                    pending=[t.name for t in self.queue])
+                self._flight.dump(
+                    "scheduler_stall",
+                    tasks=[(t.name, t.state) for t in self.queue],
+                    idle_s=self._idle_accum)
         return progressed
 
     # -- co_wait ------------------------------------------------------------

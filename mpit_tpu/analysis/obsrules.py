@@ -20,6 +20,15 @@ the Chrome trace.  Two rules:
   (``Registry.format_summary``) or the module logger instead.
   Deliberate operator output (child-log echo at gang teardown, CLI
   entry points) carries baseline suppressions with reasons.
+- **MT-O403** — undocumented metric: every ``mpit_*`` metric name
+  instantiated anywhere in the tree (``.counter()`` / ``.gauge()`` /
+  ``.histogram()`` / ``.timer()`` with a string-literal name) must
+  appear in the tree's ``docs/OBSERVABILITY.md`` catalog — the same
+  doc-conformance shape as MT-P502's tag table check.  An instrument
+  the catalog doesn't name is invisible to operators reading the doc,
+  and dashboards built from the catalog silently miss it.  Trees
+  without the doc skip the rule (fixture packages opt in by shipping
+  one).
 """
 
 from __future__ import annotations
@@ -123,6 +132,62 @@ def _check_scope(src: SourceFile, qual: str, body,
                  "the module logger")
 
 
+_METRIC_FACTORIES = {"counter", "gauge", "histogram", "timer"}
+
+
+def _find_catalog(files: List[SourceFile]) -> "str | None":
+    """The tree's docs/OBSERVABILITY.md, located scan-root-relative the
+    same way MT-P502 finds PROTOCOL.md (<root>/docs or <root>/../docs —
+    never an upward walk, so a fixture tree can't accidentally validate
+    against the real repo's catalog)."""
+    for src in files:
+        rel = pathlib.PurePosixPath(src.rel)
+        root = src.path
+        for _ in range(len(rel.parts)):
+            root = root.parent
+        for base in (root, root.parent):
+            candidate = base / "docs" / "OBSERVABILITY.md"
+            if candidate.is_file():
+                return candidate.read_text()
+        return None  # one scan root for every file
+    return None
+
+
+def _check_metric_catalog(files: List[SourceFile],
+                          findings: List[Finding]) -> None:
+    """MT-O403: every instantiated mpit_* metric name must appear in the
+    catalog.  Whole-tree scope (metrics live in comm/aio/shardctl too,
+    not just role files); one finding per (file, name)."""
+    import re
+
+    doc = _find_catalog(files)
+    if doc is None:
+        return
+    seen: Set[Tuple[str, str]] = set()
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_FACTORIES
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("mpit_")):
+                continue
+            key = (src.rel, arg.value)
+            if key in seen:
+                continue
+            seen.add(key)
+            if not re.search(rf"\b{re.escape(arg.value)}\b", doc):
+                findings.append(src.finding(
+                    "MT-O403", node,
+                    f"metric {arg.value} is instantiated here but absent "
+                    "from the docs/OBSERVABILITY.md catalog — every "
+                    "mpit_* instrument must carry a catalog row"))
+
+
 def check(files: List[SourceFile]) -> List[Finding]:
     findings: List[Finding] = []
     for src in files:
@@ -131,4 +196,5 @@ def check(files: List[SourceFile]) -> List[Finding]:
         seen: Set[Tuple[str, int]] = set()
         for qual, body in _scopes(src.tree):
             _check_scope(src, qual, body, seen, findings)
+    _check_metric_catalog(files, findings)
     return findings

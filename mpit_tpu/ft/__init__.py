@@ -31,11 +31,15 @@ from mpit_tpu.ft.retry import RetryExhausted, RetryPolicy
 from mpit_tpu.ft.wire import (
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
+    FLAG_STALENESS,
     HDR_BYTES,
+    HDR_STALE_BYTES,
     header_frame,
     init_v3,
     pack_header,
+    pack_version,
     unpack_header,
+    unpack_version,
 )
 
 __all__ = [
@@ -44,6 +48,8 @@ __all__ = [
     "FaultPlan", "FaultyTransport",
     "LeaseRegistry", "ACTIVE", "EVICTED", "STOPPED",
     "RetryPolicy", "RetryExhausted",
-    "HDR_BYTES", "FLAG_FRAMED", "FLAG_HEARTBEAT",
+    "HDR_BYTES", "HDR_STALE_BYTES",
+    "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_STALENESS",
     "pack_header", "unpack_header", "header_frame", "init_v3",
+    "pack_version", "unpack_version",
 ]

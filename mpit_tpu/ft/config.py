@@ -45,6 +45,12 @@ class FTConfig:
     epoch: int = 0
     #: server: accept a re-INIT from a restarted client incarnation.
     rejoin: bool = False
+    #: client: announce FLAG_STALENESS — frames carry the 24-byte
+    #: [epoch, seq, version] header so the server can measure gradient
+    #: staleness (mpit_ps_grad_staleness).  Requires framing
+    #: (op_deadline_s > 0); silently inactive otherwise, and negotiated
+    #: off per pair for legacy peers exactly like framing itself.
+    staleness: bool = False
 
     @property
     def active(self) -> bool:
@@ -56,6 +62,11 @@ class FTConfig:
     def framed(self) -> bool:
         """Deadlines+retry need at-most-once identity => frame headers."""
         return self.op_deadline_s > 0
+
+    @property
+    def stale_track(self) -> bool:
+        """Staleness telemetry is live: framed + requested."""
+        return self.framed and self.staleness
 
     @property
     def server_rejoin(self) -> bool:
@@ -80,6 +91,8 @@ class FTConfig:
             backoff_cap_s=_f("MPIT_FT_BACKOFF_CAP_S", 2.0),
             epoch=int(_f("MPIT_FT_EPOCH", 0)),
             rejoin=os.environ.get("MPIT_FT_REJOIN", "0") not in ("0", ""),
+            staleness=os.environ.get("MPIT_FT_STALENESS", "0")
+            not in ("0", ""),
         )
         fields.update(overrides)
         return cls(**fields)

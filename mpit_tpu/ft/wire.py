@@ -34,6 +34,15 @@ import numpy as np
 #: int64 [epoch, seq]
 HDR_BYTES = 16
 
+#: int64 [epoch, seq, version] — the staleness-tracking header
+#: extension (FLAG_STALENESS): GRAD/PARAM_PUSH frames carry the param
+#: version the client last computed against in the third word, and
+#: PARAM replies carry the snapshot's version there, so the server can
+#: measure gradient staleness (version applied-on minus version
+#: computed-against) without any extra messages.  Acks and PARAM_REQ
+#: stay 16 bytes — they never need a version slot.
+HDR_STALE_BYTES = 24
+
 #: INIT v3 flags bit0: GRAD/PARAM/PARAM_PUSH frames (and their acks /
 #: read requests) carry the [epoch, seq] header for this pair.
 FLAG_FRAMED = 1
@@ -43,6 +52,14 @@ FLAG_FRAMED = 1
 #: server with a TTL configured never evicts a client that never
 #: promised to beat (legacy ranks, framed-but-heartbeatless tests).
 FLAG_HEARTBEAT = 2
+
+#: INIT v3 flags bit2: this pair's GRAD/PARAM_PUSH/PARAM frames use the
+#: 24-byte [epoch, seq, version] header (HDR_STALE_BYTES) — the
+#: gradient-staleness telemetry extension.  Negotiated per pair exactly
+#: like framing: a legacy announcement (v1/v2, or v3 without the bit)
+#: keeps the 16-byte wire byte-for-byte, and the flag is only
+#: meaningful alongside FLAG_FRAMED (staleness needs the op identity).
+FLAG_STALENESS = 4
 
 
 def pack_header(buf: np.ndarray, epoch: int, seq: int) -> None:
@@ -55,6 +72,17 @@ def unpack_header(buf: np.ndarray) -> Tuple[int, int]:
     """(epoch, seq) from the first HDR_BYTES of a uint8 buffer."""
     hdr = buf[:HDR_BYTES].view(np.int64)
     return int(hdr[0]), int(hdr[1])
+
+
+def pack_version(buf: np.ndarray, version: int) -> None:
+    """Write the staleness extension's version word (bytes 16..24 of a
+    uint8 staging buffer whose pair negotiated FLAG_STALENESS)."""
+    buf[HDR_BYTES:HDR_STALE_BYTES].view(np.int64)[0] = version
+
+
+def unpack_version(buf: np.ndarray) -> int:
+    """The version word of a 24-byte staleness header."""
+    return int(buf[HDR_BYTES:HDR_STALE_BYTES].view(np.int64)[0])
 
 
 def header_frame(epoch: int, seq: int) -> np.ndarray:

@@ -27,6 +27,18 @@ counters.  This package is the one place the stack reports through:
 - :mod:`mpit_tpu.obs.timers` — the old ``utils/timers.py``
   (``PhaseTimers``, ``trace_annotation``, ``profiler_trace``), folded
   in; ``mpit_tpu.utils.timers`` re-exports for back-compat.
+- :mod:`mpit_tpu.obs.statusd` — the **live half**: a per-rank HTTP
+  introspection endpoint (``MPIT_OBS_HTTP=<base_port>``; base+rank per
+  process) serving ``/metrics`` (Prometheus exposition), ``/status``
+  (role/lease/map state + the in-flight op table) and ``/trace``
+  (dump-on-demand) while the gang runs.
+- :mod:`mpit_tpu.obs.flight` — a bounded **flight recorder** of recent
+  span/task/FT events, dumped to disk on ``RetryExhausted``, eviction,
+  and scheduler stall — a hang produces a postmortem instead of
+  nothing.
+- :mod:`mpit_tpu.obs.top` — ``python -m mpit_tpu.obs top``: a gang-wide
+  aggregator polling every rank's endpoint into one table (throughput,
+  staleness, retries, shard load).
 
 Enablement: ``MPIT_OBS=1`` (or ``MPIT_OBS_TRACE=<path>``, which implies
 it) turns the global registry + recorder on; :func:`configure` does the
@@ -35,6 +47,12 @@ construction, so enable *before* building transports/roles.  See
 docs/OBSERVABILITY.md for the metric catalog and trace schema.
 """
 
+from mpit_tpu.obs.flight import (
+    NULL_FLIGHT,
+    FlightRecorder,
+    get_flight,
+    validate_dump,
+)
 from mpit_tpu.obs.metrics import (
     NULL_REGISTRY,
     Counter,
@@ -54,6 +72,9 @@ from mpit_tpu.obs.spans import (
     SpanRecorder,
     get_recorder,
 )
+from mpit_tpu.obs.statusd import StatusServer
+from mpit_tpu.obs.statusd import maybe_start as maybe_start_statusd
+from mpit_tpu.obs.statusd import register_provider as register_status_provider
 from mpit_tpu.obs.timers import PhaseTimers, profiler_trace, trace_annotation
 from mpit_tpu.obs.trace import (
     maybe_merge_rank_traces,
@@ -68,6 +89,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram",
     "get_registry", "registry_or_local", "obs_enabled", "configure",
     "SpanRecorder", "OpSpan", "NULL_RECORDER", "NULL_SPAN", "get_recorder",
+    "FlightRecorder", "NULL_FLIGHT", "get_flight", "validate_dump",
+    "StatusServer", "maybe_start_statusd", "register_status_provider",
     "write_rank_trace", "merge_traces", "validate_trace",
     "maybe_write_rank_trace", "maybe_merge_rank_traces",
     "PhaseTimers", "trace_annotation", "profiler_trace",

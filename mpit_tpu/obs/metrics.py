@@ -33,6 +33,7 @@ from typing import Dict, Optional, Tuple
 
 ENV = "MPIT_OBS"
 TRACE_ENV = "MPIT_OBS_TRACE"
+HTTP_ENV = "MPIT_OBS_HTTP"
 
 #: log2 histogram layout (see module docstring).
 HIST_LO_EXP = -20
@@ -331,13 +332,16 @@ _FORCED: Optional[bool] = None
 
 def obs_enabled() -> bool:
     """True when the global registry/recorder should be live: forced via
-    :func:`configure`, ``MPIT_OBS`` truthy, or ``MPIT_OBS_TRACE`` set
-    (a trace request implies spans, which imply metrics)."""
+    :func:`configure`, ``MPIT_OBS`` truthy, ``MPIT_OBS_TRACE`` set (a
+    trace request implies spans, which imply metrics), or
+    ``MPIT_OBS_HTTP`` set (a live introspection endpoint serving an
+    empty registry would be a lie)."""
     if _FORCED is not None:
         return _FORCED
     if os.environ.get(ENV, "") not in ("", "0"):
         return True
-    return bool(os.environ.get(TRACE_ENV, ""))
+    return bool(os.environ.get(TRACE_ENV, "")
+                or os.environ.get(HTTP_ENV, ""))
 
 
 def get_registry():
@@ -369,6 +373,8 @@ def configure(enabled: Optional[bool] = None, reset: bool = False) -> None:
     _FORCED = enabled
     if reset:
         _GLOBAL = Registry()
-        from mpit_tpu.obs import spans
+        from mpit_tpu.obs import flight, spans, statusd
 
         spans.reset()
+        flight.reset()
+        statusd.clear_providers()

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from mpit_tpu.obs import flight as _flight
 from mpit_tpu.obs import metrics as _metrics
 
 
@@ -103,8 +104,13 @@ class SpanRecorder:
         self.tasks: List[Tuple[str, float, float, str]] = []
         #: monotonic -> wall offset for cross-rank trace merging
         self.epoch_offset = time.time() - time.monotonic()
+        self.flight = _flight.get_flight()
         self._hist_lock = threading.Lock()
         self._hists: Dict[Tuple[str, str], object] = {}
+        #: spans begun but not yet ended — the live in-flight op table
+        #: served by the /status introspection endpoint (obs/statusd.py)
+        #: and attached to flight-recorder dumps.
+        self._open: Dict[int, OpSpan] = {}
 
     def op(self, name: str, peer: object = "?", side: str = "client",
            **args) -> OpSpan:
@@ -114,10 +120,32 @@ class SpanRecorder:
         loops), so begin/end events nest cleanly."""
         args["peer"] = peer
         args["side"] = side
-        return OpSpan(self, name, f"{side}:{peer}:{name}", args)
+        span = OpSpan(self, name, f"{side}:{peer}:{name}", args)
+        self._open[id(span)] = span
+        return span
+
+    def open_ops(self) -> List[Dict[str, object]]:
+        """Snapshot of the in-flight ops: identity args, current phase,
+        and seconds in flight so far (one clock read per request — this
+        runs on the introspection path, never the hot path)."""
+        now = time.monotonic()
+        out = []
+        for span in list(self._open.values()):
+            out.append({
+                "op": span.name,
+                "elapsed_s": now - span.t0,
+                "phase": span.marks[-1][0] if span.marks else "",
+                **{k: v for k, v in span.args.items()},
+            })
+        return out
 
     def _finish(self, span: OpSpan) -> None:
+        self._open.pop(id(span), None)
         self.spans.append(span)
+        self.flight.record(
+            "op", name=span.name, outcome=span.outcome,
+            dur_s=span.t1 - span.t0, t0=span.t0,
+            **{k: v for k, v in span.args.items()})
         key = (span.name, str(span.args.get("side", "")))
         hist = self._hists.get(key)
         if hist is None:
@@ -137,7 +165,10 @@ class SpanRecorder:
     def task_end(self, token: Optional[float], name: str, state: str) -> None:
         if token is None:
             return  # task spawned while recording was disabled
-        self.tasks.append((name, token, time.monotonic(), state))
+        now = time.monotonic()
+        self.tasks.append((name, token, now, state))
+        self.flight.record("task", name=name, state=state,
+                           dur_s=now - token, t0=token)
 
 
 class NullRecorder:
@@ -152,6 +183,9 @@ class NullRecorder:
     def op(self, name: str, peer: object = "?", side: str = "client",
            **args) -> NullSpan:
         return NULL_SPAN
+
+    def open_ops(self) -> list:
+        return []
 
     def task_begin(self, name: str) -> None:
         return None

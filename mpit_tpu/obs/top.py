@@ -1,0 +1,241 @@
+"""``python -m mpit_tpu.obs top`` — one table for a whole running gang.
+
+Polls every rank's statusd endpoint (``/metrics`` + ``/status``,
+obs/statusd.py) and renders per-rank throughput, gradient staleness,
+retries/evictions and shard load side by side — the live view of the
+failure modes the PS literature says matter at scale (stragglers show
+up as one rank's ops/s collapsing; skewed arrival as a staleness tail;
+retry storms in the retries column; shard imbalance in the load column).
+
+The collection half (:func:`parse_exposition`, :func:`poll_rank`,
+:func:`collect`) is a library surface on purpose: the shardctl
+controller and the planned admission-control tier read the same
+endpoints, so "what the operator sees" and "what the control plane
+acts on" cannot drift apart.
+
+Usage::
+
+    MPIT_OBS_HTTP=8780 python -m mpit_tpu.train.launch --np 4 ... &
+    python -m mpit_tpu.obs top --np 4 --base-port 8780
+
+``--iters N`` bounds the refresh loop (0 = until interrupted);
+``--json`` emits one machine-readable snapshot per refresh instead of
+the table (CI and scripts); ``--retry-s`` keeps polling an endpoint
+that is not up yet (gang still importing jax) before giving up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_BASE_PORT = 8780
+
+_LINE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)$')
+_LABEL = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_exposition(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Prometheus text exposition -> [(name, labels, value)].  Ignores
+    comments and anything that does not parse as a sample line."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        out.append((m.group("name"), labels, value))
+    return out
+
+
+def metric_sum(samples, name: str, **match) -> float:
+    """Sum of every series of ``name`` whose labels include ``match``."""
+    total = 0.0
+    for n, labels, value in samples:
+        if n == name and all(labels.get(k) == str(v)
+                             for k, v in match.items()):
+            total += value
+    return total
+
+
+def hist_mean(samples, name: str) -> Optional[float]:
+    """Mean of a histogram from its ``_sum``/``_count`` series (all
+    label sets pooled); None when it never observed."""
+    count = metric_sum(samples, name + "_count")
+    if count <= 0:
+        return None
+    return metric_sum(samples, name + "_sum") / count
+
+
+def _get(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def poll_rank(host: str, port: int, timeout: float = 2.0) -> dict:
+    """One rank's full readout: parsed /metrics samples + /status JSON.
+    Raises OSError/URLError when the endpoint is unreachable."""
+    metrics = parse_exposition(
+        _get(f"http://{host}:{port}/metrics", timeout).decode())
+    status = json.loads(_get(f"http://{host}:{port}/status", timeout))
+    return {"metrics": metrics, "status": status, "port": port}
+
+
+def collect(host: str, base: int, nranks: int,
+            timeout: float = 2.0) -> Dict[int, Optional[dict]]:
+    """Poll ranks 0..nranks-1; unreachable ranks map to None (a rank
+    that exited or has not bound yet is a row, not a crash)."""
+    out: Dict[int, Optional[dict]] = {}
+    for rank in range(nranks):
+        try:
+            out[rank] = poll_rank(host, base + rank, timeout)
+        except (OSError, ValueError, urllib.error.URLError):
+            out[rank] = None
+    return out
+
+
+def _rank_row(rank: int, sample: Optional[dict],
+              prev: Optional[dict], dt: Optional[float]) -> Dict[str, object]:
+    """One rank's table row (also the --json record)."""
+    if sample is None:
+        return {"rank": rank, "up": False}
+    m = sample["metrics"]
+    status = sample["status"]
+    ops = (metric_sum(m, "mpit_ps_grads_applied_total")
+           + metric_sum(m, "mpit_ps_params_served_total"))
+    row: Dict[str, object] = {
+        "rank": rank,
+        "up": True,
+        "role": status.get("role") or "",
+        "ops_total": int(ops),
+        "ops_per_s": None,
+        "staleness_mean": hist_mean(m, "mpit_ps_grad_staleness"),
+        "retries": int(metric_sum(m, "mpit_ft_retries_total")),
+        "evictions": int(metric_sum(m, "mpit_ft_evictions_total")),
+        "shards": int(metric_sum(m, "mpit_shardctl_owned_shards")),
+        "shard_busy_s": metric_sum(m, "mpit_shardctl_shard_busy_seconds_sum"),
+        "map_version": int(metric_sum(m, "mpit_shardctl_map_version")),
+        "inflight": len(status.get("inflight_ops") or []),
+    }
+    if prev is not None and dt and dt > 0:
+        prev_ops = (metric_sum(prev["metrics"], "mpit_ps_grads_applied_total")
+                    + metric_sum(prev["metrics"],
+                                 "mpit_ps_params_served_total"))
+        row["ops_per_s"] = (ops - prev_ops) / dt
+    return row
+
+
+_COLUMNS = ("rank", "role", "ops", "ops/s", "stale", "retry", "evict",
+            "shards", "busy_s", "mapv", "infl")
+
+
+def render_table(rows: List[Dict[str, object]]) -> str:
+    def fmt(row: Dict[str, object]) -> List[str]:
+        if not row.get("up"):
+            return [str(row["rank"]), "(down)"] + ["-"] * (len(_COLUMNS) - 2)
+        stale = row["staleness_mean"]
+        ops_s = row["ops_per_s"]
+        return [
+            str(row["rank"]), str(row["role"]) or "?",
+            str(row["ops_total"]),
+            f"{ops_s:.1f}" if ops_s is not None else "-",
+            f"{stale:.2f}" if stale is not None else "-",
+            str(row["retries"]), str(row["evictions"]),
+            str(row["shards"]) if row["shards"] else "-",
+            f"{row['shard_busy_s']:.2f}" if row["shard_busy_s"] else "-",
+            str(row["map_version"]) if row["map_version"] else "-",
+            str(row["inflight"]),
+        ]
+
+    cells = [list(_COLUMNS)] + [fmt(r) for r in rows]
+    widths = [max(len(row[i]) for row in cells)
+              for i in range(len(_COLUMNS))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in cells)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.obs top",
+        description="live per-rank telemetry for a running gang")
+    parser.add_argument("--np", type=int, required=True,
+                        help="gang size (ranks 0..np-1 are polled)")
+    parser.add_argument("--base-port", type=int, default=None,
+                        help=f"statusd base port (default: $MPIT_OBS_HTTP "
+                             f"or {DEFAULT_BASE_PORT})")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="seconds between refreshes")
+    parser.add_argument("--iters", type=int, default=0,
+                        help="number of refreshes (0 = until interrupted)")
+    parser.add_argument("--retry-s", type=float, default=0.0,
+                        help="keep polling this long for the first rank to "
+                             "come up before the first render")
+    parser.add_argument("--min-up", type=int, default=0,
+                        help="exit 1 unless at least this many ranks "
+                             "responded on the final refresh")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON snapshot per refresh")
+    args = parser.parse_args(argv)
+    import os
+
+    base = args.base_port
+    if base is None:
+        env = os.environ.get("MPIT_OBS_HTTP", "")
+        base = int(env) if env else DEFAULT_BASE_PORT
+
+    if args.retry_s > 0:
+        deadline = time.monotonic() + args.retry_s
+        while time.monotonic() < deadline:
+            if any(s is not None
+                   for s in collect(args.host, base, args.np).values()):
+                break
+            time.sleep(0.5)
+
+    prev: Dict[int, Optional[dict]] = {}
+    prev_t: Optional[float] = None
+    i = 0
+    up = 0
+    try:
+        while True:
+            i += 1
+            now = time.monotonic()
+            samples = collect(args.host, base, args.np)
+            dt = (now - prev_t) if prev_t is not None else None
+            rows = [_rank_row(r, samples[r], prev.get(r), dt)
+                    for r in range(args.np)]
+            up = sum(1 for r in rows if r.get("up"))
+            if args.json:
+                print(json.dumps({"ranks": rows}))
+            else:
+                print(render_table(rows))
+                print(f"-- {up}/{args.np} rank(s) up; refresh {i}"
+                      + (f"/{args.iters}" if args.iters else "") + " --")
+            sys.stdout.flush()
+            prev, prev_t = samples, now
+            if args.iters and i >= args.iters:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0 if up >= args.min_up else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mpit_tpu.obs import get_registry
 from mpit_tpu.optim.client_api import ParamClientAPI
 
 
@@ -61,6 +62,14 @@ class Downpour:
         self.k = 0
         self.dusync = 0.0  # blocking-sync seconds (reference state.dusync)
         self._started = False
+        # Training telemetry (mpit_tpu.obs): loss + shipped-update norm
+        # gauges, written only on sync rounds (where host copies already
+        # happen, so no extra device sync) and only when obs is enabled
+        # (the norm is an O(n) host reduction).
+        _reg = get_registry()
+        self._obs = _reg.enabled
+        self._m_loss = _reg.gauge("mpit_train_loss", opt="downpour")
+        self._m_unorm = _reg.gauge("mpit_train_update_norm", opt="downpour")
 
         def _local(w, accum, k, *args):
             loss, g = value_and_grad_fn(w, *args)
@@ -84,6 +93,8 @@ class Downpour:
     def _sync(self, payload: jnp.ndarray) -> jnp.ndarray:
         """Ship ``payload`` as the grad, fetch fresh params, time the wait."""
         np.copyto(self.grad_host, np.asarray(payload))
+        if self._obs:
+            self._m_unorm.set(float(np.linalg.norm(self.grad_host)))
         self.pc.async_send_grad()
         self.pc.async_recv_param()
         t0 = time.monotonic()
@@ -96,6 +107,7 @@ class Downpour:
         k = jnp.asarray(self.k, jnp.int32)
         loss, dfdx, accum, w_local = self._local(w, self.accum, k, *fn_args)
 
+        synced = self.su == 1 or self.k % self.su == 0
         if self.su == 1:
             w = self._sync(dfdx)
         elif self.k % self.su == 0:
@@ -104,6 +116,8 @@ class Downpour:
         else:
             self.accum = accum
             w = w_local  # move locally between syncs (reference :44)
+        if self._obs and synced:
+            self._m_loss.set(float(loss))
 
         self.k += 1
         return w, loss

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mpit_tpu.obs import get_registry
 from mpit_tpu.optim.client_api import ParamClientAPI
 from mpit_tpu.optim.msgd import MSGDConfig, msgd_commit, msgd_init, msgd_lookahead
 
@@ -64,6 +65,15 @@ class EAMSGD:
         self.mva = mva
         self.dusync = 0.0
         self._started = False
+        # Training telemetry (mpit_tpu.obs): the elastic distance
+        # ||w - w*|| is EASGD's own convergence signal — the exploration
+        # radius the mva force is pulling back.  Derived from the sug
+        # host mirror on sync rounds only, and only when obs is enabled
+        # (it is an O(n) host reduction).
+        _reg = get_registry()
+        self._obs = _reg.enabled
+        self._m_dist = _reg.gauge("mpit_train_elastic_distance", opt="eamsgd")
+        self._m_unorm = _reg.gauge("mpit_train_update_norm", opt="eamsgd")
         # Local rule = msgd without the momentum ramp (reference :24-45).
         cfg = MSGDConfig(lr=lr, lrd=lrd, lrp=lrp, mom=mom, momdecay=0.0, l2wd=l2wd)
         self.cfg = cfg
@@ -121,6 +131,11 @@ class EAMSGD:
             else:
                 sug = self._elastic(w, jnp.asarray(self.center_host))
             np.copyto(self.sug_host, np.asarray(sug))
+            if self._obs:
+                # sug = mva * (w - w*): one norm serves both gauges.
+                unorm = float(np.linalg.norm(self.sug_host))
+                self._m_unorm.set(unorm)
+                self._m_dist.set(unorm / self.mva)
             self.pc.async_send_grad()  # server: w* += sug
             t0 = time.monotonic()
             self.pc.ping()  # overlap I/O with local compute (reference :63)

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mpit_tpu.obs import get_registry
 from mpit_tpu.optim import rules as rules_mod
 from mpit_tpu.optim.client_api import ParamClientAPI
 from mpit_tpu.optim.msgd import MSGDConfig, msgd_init, msgd_step
@@ -68,6 +69,14 @@ class RuleShell:
         self.k = 0
         self.dusync = 0.0
         self._started = False
+        # Training telemetry (mpit_tpu.obs): loss + shipped-update norm,
+        # written on sync rounds only and only when obs is enabled (the
+        # norm is an O(n) host reduction over the grad mirror).
+        _reg = get_registry()
+        self._obs = _reg.enabled
+        self._m_loss = _reg.gauge("mpit_train_loss", opt=f"rule-{mode}")
+        self._m_unorm = _reg.gauge("mpit_train_update_norm",
+                                   opt=f"rule-{mode}")
         if mode == "global":
             self._vgf = jax.jit(value_and_grad_fn)
 
@@ -98,6 +107,8 @@ class RuleShell:
 
     def _sync(self, payload: jnp.ndarray) -> jnp.ndarray:
         np.copyto(self.grad_host, np.asarray(payload))
+        if self._obs:
+            self._m_unorm.set(float(np.linalg.norm(self.grad_host)))
         self.pc.async_send_grad()
         self.pc.async_recv_param()
         t0 = time.monotonic()
@@ -107,6 +118,10 @@ class RuleShell:
 
     def step(self, w: jnp.ndarray, *fn_args: Any) -> Tuple[jnp.ndarray, jnp.ndarray]:
         assert self._started, "call start(w) first"
+        if self._obs and (self.su == 1 or self.k % self.su == 0):
+            synced_loss = True
+        else:
+            synced_loss = False
         if self.mode == "global":
             loss, g = self._vgf(w, *fn_args)
             if self.su == 1:
@@ -129,6 +144,8 @@ class RuleShell:
             else:
                 self.accum = accum
                 w = w + update  # move locally (reference :63)
+        if synced_loss:
+            self._m_loss.set(float(loss))
         self.k += 1
         return w, loss
 

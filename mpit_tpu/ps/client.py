@@ -67,16 +67,27 @@ from mpit_tpu.comm.transport import Transport
 from mpit_tpu.ft import (
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
+    FLAG_STALENESS,
     HDR_BYTES,
+    HDR_STALE_BYTES,
     FTConfig,
     RetryExhausted,
     RetryPolicy,
     header_frame,
     init_v3,
     pack_header,
+    pack_version,
     unpack_header,
+    unpack_version,
 )
-from mpit_tpu.obs import NULL_SPAN, get_recorder, registry_or_local
+from mpit_tpu.obs import (
+    NULL_SPAN,
+    get_flight,
+    get_recorder,
+    obs_enabled,
+    register_status_provider,
+    registry_or_local,
+)
 from mpit_tpu.ps import tags
 from mpit_tpu.ps.sharding import Shard
 from mpit_tpu.shardctl import shardmap as _shardmap
@@ -126,10 +137,22 @@ class ParamClient:
         self.grad: Optional[np.ndarray] = None
         self.shards: List[Shard] = []
         self._started = False
+        # Staleness telemetry (mpit_tpu.obs): with FLAG_STALENESS
+        # negotiated, PARAM replies carry the served snapshot version and
+        # the next GRAD echoes the version this client computed against —
+        # the server's mpit_ps_grad_staleness histogram measures the gap.
+        # Rides the framed wire (the header grows 16 -> 24 bytes);
+        # shardctl's shard-addressed header has no version slot yet, so
+        # the flag negotiates off there (docs/PROTOCOL.md §6.6).
+        self._stale = self.ft.stale_track and not self._sc
+        #: per-server param version this client last read (the basis the
+        #: next gradient is computed against); 0 until the first read.
+        self._basis: Dict[int, int] = {}
         # Per-server codec state: encode/decode staging sized to the wire
         # format (plus the FT header when framed), plus the int8
         # error-feedback residual (grad path only).
-        self._hdr = HDR_BYTES if self.ft.framed else 0
+        self._hdr = ((HDR_STALE_BYTES if self._stale else HDR_BYTES)
+                     if self.ft.framed else 0)
         self._grad_wire: Dict[int, np.ndarray] = {}
         self._param_wire: Dict[int, np.ndarray] = {}
         self._residual: Dict[int, np.ndarray] = {}
@@ -157,6 +180,14 @@ class ParamClient:
             "mpit_shardctl_reroutes_total", rank=rank)
         self._m_mapver = self.metrics.gauge(
             "mpit_shardctl_map_version", rank=rank)
+        # Flight recorder + live introspection (obs/flight, obs/statusd):
+        # the retry-exhaustion paths dump the recent-event ring so a
+        # failed op leaves a postmortem; the status provider feeds the
+        # /status endpoint when one is serving.  Both are null/no-op when
+        # obs is disabled.
+        self._flight = get_flight()
+        if obs_enabled():
+            register_status_provider(f"client{rank}", self._status_section)
         # shardctl per-shard state: encode staging + residual keyed by
         # shard_id (stable across migrations — placement moves, the cut
         # never does), per-(shard, tag) seq streams, one global FIFO op
@@ -197,7 +228,7 @@ class ParamClient:
         self.shards = [e.shard for e in self.smap.entries]
         flags = (FLAG_FRAMED if self.ft.framed else 0) | (
             FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0
-        )
+        ) | (FLAG_STALENESS if self._stale else 0)
         for srank, shard in zip(self.sranks, self.shards):
             if not self.codec.identity:
                 nbytes = self._hdr + self.codec.wire_nbytes(shard.size)
@@ -259,6 +290,44 @@ class ParamClient:
             raise ValueError("reset buffers must keep the registered length")
         self._register(param, grad)
 
+    # -- live introspection (obs/statusd) ------------------------------------
+
+    def _status_section(self) -> Dict[str, object]:
+        """This client's /status section: identity, negotiation posture,
+        per-server basis versions and the pending op-pump task table.
+        Runs on the statusd thread — reads plain attributes only."""
+        try:
+            tasks = [t.name for t in list(self.sched.queue)]
+        except RuntimeError:  # deque mutated mid-snapshot; next poll wins
+            tasks = ["<scheduler busy>"]
+        return {
+            "role": "client",
+            "rank": self.rank,
+            "servers": self.sranks,
+            "codec": self.codec.name,
+            "epoch": self.ft.epoch,
+            "framed": self.ft.framed,
+            "staleness": self._stale,
+            "basis_versions": {str(s): v for s, v in self._basis.items()},
+            "map_version": getattr(self.smap, "version", None),
+            "retries": self.retries,
+            "tasks": tasks,
+        }
+
+    def _flight_dump(self, reason: str, **fields) -> None:
+        """Record + dump the flight ring on a terminal failure (no-op
+        when obs is off).  The dump rides next to the raised exception:
+        the exception names the op, the dump shows the ring of events
+        that led to it plus the live task table."""
+        self._flight.record(reason, rank=self.rank, **fields)
+        try:
+            tasks = [(t.name, t.state) for t in list(self.sched.queue)]
+        except RuntimeError:
+            tasks = None
+        path = self._flight.dump(reason, tasks=tasks, **fields)
+        if path:
+            self.log.warning("%s: flight recorder dumped to %s", reason, path)
+
     # -- observability back-compat reads ------------------------------------
 
     @property
@@ -313,6 +382,8 @@ class ParamClient:
             except DeadlineExceeded as exc:
                 last = exc
         span.end("exhausted")
+        self._flight_dump("retry_exhausted", what=what,
+                          attempts=self._retry.attempts, peer=srank)
         raise RetryExhausted(what, self._retry.attempts, last)
 
     def _await_ack(self, srank: int, ack_tag: int, seq: int,
@@ -544,6 +615,9 @@ class ParamClient:
                 attempt += 1
                 if attempt >= self._retry.attempts:
                     span.end("exhausted")
+                    self._flight_dump("retry_exhausted", what=what,
+                                      attempts=self._retry.attempts,
+                                      shard=sid)
                     raise RetryExhausted(what, self._retry.attempts, last)
                 backoff = self._retry.backoff_s(attempt)
                 self._m_retries.inc()
@@ -573,6 +647,9 @@ class ParamClient:
             span.mark("nack")
             if nacks > max_nacks:
                 span.end("exhausted")
+                self._flight_dump("retry_exhausted",
+                                  what=f"{what} (map churn)", nacks=nacks,
+                                  shard=sid)
                 raise RetryExhausted(f"{what} (map churn)", nacks, last)
             if len(body) and self._sc_install_wire(body) \
                     and self.smap.owner(sid) != owner:
@@ -647,6 +724,13 @@ class ParamClient:
         seq = self._next_seq(srank, tags.GRAD)
         span.note(epoch=self.ft.epoch, seq=seq)
         pack_header(payload, self.ft.epoch, seq)
+        if self._stale:
+            # Echo the param version this gradient was computed against
+            # (the last PARAM read from this server); the server measures
+            # the staleness gap at apply time.
+            basis = self._basis.get(srank, 0)
+            pack_version(payload, basis)
+            span.note(basis=basis)
         yield from self._op_with_retry(
             srank, payload, tags.GRAD, tags.GRAD_ACK, seq,
             f"GRAD to server {srank}", span=span,
@@ -707,6 +791,10 @@ class ParamClient:
                         return
                     epoch, aseq = unpack_header(wire)
                     if epoch == self.ft.epoch and aseq == seq:
+                        if self._stale:
+                            # The reply's version word is the basis the
+                            # next gradient to this server will echo.
+                            self._basis[srank] = unpack_version(wire)
                         span.mark("decode")
                         self._decode_framed(wire, out)
                         span.end("ok")
@@ -715,6 +803,9 @@ class ParamClient:
             except DeadlineExceeded as exc:
                 last = exc
         span.end("exhausted")
+        self._flight_dump("retry_exhausted",
+                          what=f"PARAM read from server {srank}",
+                          attempts=self._retry.attempts, peer=srank)
         raise RetryExhausted(
             f"PARAM read from server {srank}", self._retry.attempts, last)
 
@@ -740,6 +831,11 @@ class ParamClient:
         seq = self._next_seq(srank, tags.PARAM_PUSH)
         span.note(epoch=self.ft.epoch, seq=seq)
         pack_header(payload, self.ft.epoch, seq)
+        if self._stale:
+            # Pushes fill the version word too (uniform 24-byte layout);
+            # the server ignores it — a whole-shard write is a state
+            # transfer, not a gradient with a basis.
+            pack_version(payload, self._basis.get(srank, 0))
         yield from self._op_with_retry(
             srank, payload, tags.PARAM_PUSH, tags.PARAM_PUSH_ACK, seq,
             f"PARAM_PUSH to server {srank}", span=span,

@@ -82,14 +82,24 @@ from mpit_tpu.ft import (
     DUP,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
+    FLAG_STALENESS,
     HDR_BYTES,
+    HDR_STALE_BYTES,
     STALE,
     DedupTable,
     FTConfig,
     LeaseRegistry,
+    pack_version,
     unpack_header,
+    unpack_version,
 )
-from mpit_tpu.obs import get_recorder, registry_or_local
+from mpit_tpu.obs import (
+    get_flight,
+    get_recorder,
+    obs_enabled,
+    register_status_provider,
+    registry_or_local,
+)
 from mpit_tpu.optim.rules import ShardRule, make as make_rule
 from mpit_tpu.ps import tags
 from mpit_tpu.shardctl import migrate as _scmigrate
@@ -157,6 +167,13 @@ class ParamServer:
         self.dedup = DedupTable()
         self._framed: Dict[int, bool] = {}
         self._hb: Dict[int, bool] = {}
+        # Staleness telemetry (FLAG_STALENESS, negotiated per pair like
+        # framing): frames from these clients carry the 24-byte
+        # [epoch, seq, version] header; PARAM replies are stamped with
+        # the served snapshot version and each applied GRAD's basis gap
+        # feeds the mpit_ps_grad_staleness histogram.
+        self._stale_track: Dict[int, bool] = {}
+        self._stale_hists: Dict[int, Any] = {}
         self._gen: Dict[int, int] = {c: 0 for c in self.cranks}
         self._svc_live: Dict[int, int] = {c: 0 for c in self.cranks}
         self._param_send: Dict[int, np.ndarray] = {}
@@ -209,6 +226,13 @@ class ParamServer:
                                       rank=_r)
         self._m_sc_ver = _m.gauge("mpit_shardctl_map_version", rank=_r)
         self._m_sc_owned = _m.gauge("mpit_shardctl_owned_shards", rank=_r)
+        # Flight recorder + live introspection (obs/flight, obs/statusd):
+        # evictions dump the recent-event ring (the gang just lost a
+        # member) and the status provider feeds /status when an endpoint
+        # is serving.  Null objects when obs is disabled.
+        self._flight = get_flight()
+        if obs_enabled():
+            register_status_provider(f"server{rank}", self._status_section)
         # Version-counted snapshot cache: _snap_version bumps on every
         # committed write (grad apply / seed / restore); _snap_host is
         # the one device->host copy for that version and _snap_wire the
@@ -241,6 +265,37 @@ class ParamServer:
         # Periodic shard checkpointing (the resume flow's producer side).
         self._ckpt_dir = str(ckpt_dir) if ckpt_dir else None
         self._ckpt_interval = float(ckpt_interval)
+
+    # -- live introspection (obs/statusd) ------------------------------------
+
+    def _status_section(self) -> Dict[str, Any]:
+        """This server's /status section: shard + snapshot state, the
+        per-client lease/negotiation table, shardctl placement, and the
+        live task table.  Runs on the statusd thread — plain-attribute
+        reads only, never the scheduler."""
+        try:
+            tasks = [t.name for t in list(self.sched.queue)]
+        except RuntimeError:  # deque mutated mid-snapshot; next poll wins
+            tasks = ["<scheduler busy>"]
+        return {
+            "role": "server",
+            "rank": self.rank,
+            "shard": {"offset": self.offset, "size": self.size},
+            "snap_version": self._snap_version,
+            "map_version": getattr(self.smap, "version", None),
+            "owned_shards": sorted(self._slots),
+            "clients": {
+                str(c): {
+                    "state": self.leases.state(c),
+                    "epoch": self.leases.epoch(c),
+                    "framed": self._framed.get(c, False),
+                    "stale": self._stale_track.get(c, False),
+                    "codec": getattr(self._codecs.get(c), "name", None),
+                }
+                for c in self.cranks
+            },
+            "tasks": tasks,
+        }
 
     # -- registry-backed counter reads (the pre-obs attribute surface) -------
 
@@ -359,6 +414,11 @@ class ParamServer:
             )
         self._framed[crank] = bool(flags & FLAG_FRAMED)
         self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
+        # Staleness telemetry only rides the framed wire: the version
+        # word extends the [epoch, seq] header, so a FLAG_STALENESS
+        # without FLAG_FRAMED negotiates off (nothing to extend).
+        self._stale_track[crank] = (self._framed[crank]
+                                    and bool(flags & FLAG_STALENESS))
         self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
         return codec
 
@@ -399,6 +459,9 @@ class ParamServer:
                 self._sc_make_slot(e.shard_id, e.shard)
         self._framed[crank] = True
         self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
+        # The 32-byte shard-addressed header has no version slot; the
+        # staleness extension negotiates off under shardctl (§6.6).
+        self._stale_track[crank] = False
         self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
         return codec
 
@@ -417,7 +480,19 @@ class ParamServer:
         return slot
 
     def _hdr_for(self, crank: int) -> int:
-        return HDR_BYTES if self._framed.get(crank) else 0
+        if not self._framed.get(crank):
+            return 0
+        return HDR_STALE_BYTES if self._stale_track.get(crank) else HDR_BYTES
+
+    def _stale_hist(self, crank: int):
+        """The per-client staleness histogram, cached (one get-or-create
+        per client lifetime, plain attribute updates per observe)."""
+        hist = self._stale_hists.get(crank)
+        if hist is None:
+            hist = self.metrics.histogram(
+                "mpit_ps_grad_staleness", rank=self.rank, client=crank)
+            self._stale_hists[crank] = hist
+        return hist
 
     def _alloc_client(self, crank: int, codec: "codec_mod.Codec") -> None:
         """(Re)allocate every per-client staging buffer for the client's
@@ -723,14 +798,19 @@ class ParamServer:
                 continue
             self.leases.renew(crank, epoch)
             span.mark("snapshot")
+            hdr = self._hdr_for(crank)
             wire = self._snapshot_wire(codec)
             wire_u8 = wire.view(np.uint8) if wire.dtype != np.uint8 else wire
             reply = self._param_send.get(crank)
-            if reply is None or len(reply) != HDR_BYTES + len(wire_u8):
-                reply = np.zeros(HDR_BYTES + len(wire_u8), np.uint8)
+            if reply is None or len(reply) != hdr + len(wire_u8):
+                reply = np.zeros(hdr + len(wire_u8), np.uint8)
                 self._param_send[crank] = reply
             reply[:HDR_BYTES].view(np.int64)[:] = (epoch, seq)
-            reply[HDR_BYTES:] = wire_u8
+            if self._stale_track.get(crank):
+                # Stamp the served snapshot's version — the basis the
+                # client's next gradient will echo (staleness telemetry).
+                pack_version(reply, self._snap_version)
+            reply[hdr:] = wire_u8
             span.mark("send")
             yield from aio_send(
                 self.transport, reply, crank, tags.PARAM, live=self.live,
@@ -778,6 +858,16 @@ class ParamServer:
                                               epoch, seq, gen)
                     span.end("dup")
                     continue
+                if self._stale_track.get(crank):
+                    # Gradient staleness: the gap between the version the
+                    # client computed against (echoed in the header) and
+                    # the version this gradient lands on.  Observed once
+                    # per *applied* op — dups/stales above never count,
+                    # so under a deterministic fault plan the histogram
+                    # matches the plan arithmetic exactly.
+                    staleness = self._snap_version - unpack_version(gbuf)
+                    span.note(staleness=staleness)
+                    self._stale_hist(crank).observe(staleness)
             span.mark("apply")
             with self._dev_ctx():
                 if parts is None:
@@ -1239,6 +1329,14 @@ class ParamServer:
                 self._m_evictions.inc()
                 self._gen[crank] += 1  # stale loops abort at next poll
                 self._release_client(crank)
+                # Postmortem: the gang just lost a member — dump the
+                # recent-event ring + live task table (obs/flight.py;
+                # no-op when obs is disabled).
+                self._flight.record("eviction", client=crank,
+                                    rank=self.rank)
+                self._flight.dump(
+                    "eviction", client=crank,
+                    tasks=[(t.name, t.state) for t in list(self.sched.queue)])
             if self.leases.all_done():
                 self.live.stop()
                 return
@@ -1256,6 +1354,7 @@ class ParamServer:
                 "codec": self._codecs[c].name,
                 "framed": self._framed.get(c, False),
                 "hb": self._hb.get(c, False),
+                "stale": self._stale_track.get(c, False),
                 "epoch": self.leases.epoch(c),
             }
             for c in self._codecs
@@ -1333,6 +1432,7 @@ class ParamServer:
                 continue
             self._framed[crank] = bool(info.get("framed", False))
             self._hb[crank] = bool(info.get("hb", False))
+            self._stale_track[crank] = bool(info.get("stale", False))
             self.leases.arm(crank, int(info.get("epoch", 0)),
                             heartbeats=self._hb[crank])
             self._alloc_client(crank, codec_mod.get(info.get("codec", "none")))

@@ -197,6 +197,11 @@ def _child_main() -> None:
     from mpit_tpu.train.gang import child_env, child_transport, write_result
 
     rank, size, cfg = child_env()
+    # Live introspection endpoint (obs/statusd; no-op unless
+    # MPIT_OBS_HTTP is set) — same hook as train/launch.py children.
+    from mpit_tpu.obs import maybe_start_statusd
+
+    maybe_start_statusd(rank)
     transport = child_transport(cfg, rank, size)
     result = run_rank(rank, size, cfg, transport)
     transport.close()

@@ -76,6 +76,11 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     ft_lease_ttl_s=0.0,
     ft_op_deadline_s=0.0,
     ft_max_retries=8,
+    # Gradient-staleness telemetry (obs): frames carry the 24-byte
+    # [epoch, seq, version] header so servers measure the basis gap per
+    # applied grad (mpit_ps_grad_staleness).  Needs ft_op_deadline_s > 0
+    # (rides the framed wire); silently off otherwise.
+    ft_staleness=False,
     supervise=0,
     # shardctl (mpit_tpu.shardctl): the LAST rank becomes the shard-map
     # controller (the rest split into servers/clients as usual), clients
@@ -110,6 +115,8 @@ def ft_from_cfg(cfg: Config):
         overrides["max_retries"] = int(cfg.get("ft_max_retries", 8))
     if overrides.get("lease_ttl_s") or int(cfg.get("supervise", 0)):
         overrides["rejoin"] = True
+    if bool(cfg.get("ft_staleness", False)):
+        overrides["staleness"] = True
     return FTConfig.from_env(**overrides)
 
 
@@ -260,10 +267,39 @@ def run_rank(
 # -- process-mode launcher (the mpirun analog) -------------------------------
 
 
+def expected_role(rank: int, size: int, cfg: Config) -> str:
+    """The role this rank will run, derived the same way run_rank does —
+    for labeling introspection endpoints/flight dumps *before* the role
+    objects exist.  Best-effort: '' when the split is invalid (run_rank
+    raises the real error)."""
+    if size == 1:
+        return "local"
+    sc_on = bool(cfg.get("shardctl", False))
+    if sc_on and rank == size - 1:
+        return "controller"
+    try:
+        sranks, _cranks, tester_rank = assign_roles(
+            size - 1 if sc_on else size, int(cfg.get("master_freq", 2)),
+            str(cfg.get("tester", "none")))
+    except ValueError:
+        return ""
+    if rank == tester_rank:
+        return "tester"
+    return "server" if rank in sranks else "worker"
+
+
 def _child_main() -> None:
     from mpit_tpu.train.gang import child_env, child_transport, write_result
 
     rank, size, cfg = child_env()
+    # Live introspection (obs/statusd; no-op unless MPIT_OBS_HTTP is
+    # set): serve /metrics, /status and /trace on base_port + rank for
+    # the whole life of this rank.  Flight dumps inherit the identity.
+    from mpit_tpu.obs import get_flight, maybe_start_statusd
+
+    role = expected_role(rank, size, cfg)
+    maybe_start_statusd(rank, role=role)
+    get_flight().set_identity(rank=rank, role=role)
     transport = child_transport(cfg, rank, size)
     result = run_rank(rank, size, cfg, transport)
     transport.close()
@@ -348,6 +384,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     cfg = LAUNCH_DEFAULTS.parse_args(argv)
     t0 = time.monotonic()
     if int(cfg.np) == 1:
+        from mpit_tpu.obs import maybe_start_statusd
+
+        maybe_start_statusd(0, role="local")
         result = run_rank(0, 1, cfg, transport=None)
         from mpit_tpu.obs import maybe_merge_rank_traces, maybe_write_rank_trace
 

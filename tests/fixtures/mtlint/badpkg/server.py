@@ -36,3 +36,11 @@ def timing_report():
 def drain_rogue(transport, live, gone):
     # Peer side of the MT-P501/MT-P502 seed (keeps the channel paired).
     yield from aio_recv(transport, 1, tags.ROGUE, live=live, abort=gone)
+
+
+def report_widgets(registry):
+    # MT-O403 seed: mpit_rogue_widgets_total is instantiated but absent
+    # from this fixture's docs/OBSERVABILITY.md catalog; the documented
+    # mpit_good_widgets_total must stay silent.
+    registry.counter("mpit_good_widgets_total").inc()
+    registry.counter("mpit_rogue_widgets_total").inc()

@@ -126,6 +126,15 @@ class TestSeededViolations:
         assert [(f.path, f.line) for f in hits] == [("tags.py", 9)]
         assert "PROTOCOL.md" in hits[0].message
 
+    def test_undocumented_metric_detected(self, bad):
+        # MT-O403: mpit_rogue_widgets_total is instantiated but absent
+        # from the fixture's docs/OBSERVABILITY.md; the documented
+        # mpit_good_widgets_total on the line above stays silent.
+        hits = bad.get("MT-O403", [])
+        assert [(f.path, f.line) for f in hits] == [("server.py", 46)]
+        assert "mpit_rogue_widgets_total" in hits[0].message
+        assert "OBSERVABILITY.md" in hits[0].message
+
     def test_nonbinary_pairs_exempt_from_role_model(self, bad):
         # The pairing table is what exempts controller / server<->server
         # tags from MT-P101/P102 — the badpkg table is all-binary, so

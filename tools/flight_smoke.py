@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""Flight-recorder smoke — part of the CI `obs-trace` job (ci.yml).
+
+Runs a 2-server / 2-client gang on the in-process router with obs
+enabled, a staleness-tracking framed wire, and live introspection
+endpoints, then severs client 0's link to every server mid-run.
+Asserts the whole live-telemetry surface:
+
+1. every rank-shaped endpoint probe works while the gang runs — the
+   client's statusd `/metrics` exposition carries its retry counters
+   and `/status` its in-flight op table;
+2. the sever drives the client's GRAD to `RetryExhausted` — loud
+   failure, never a hang;
+3. the failure leaves a **flight-recorder dump** on disk whose schema
+   validates (`mpit_tpu.obs.flight.validate_dump` and the
+   `python -m mpit_tpu.obs flight` CLI), carrying the
+   `retry_exhausted` event and the live task table;
+4. the staleness histograms populated before the sever are present in
+   the final registry snapshot.
+
+Exit code 0 on success.  Usage:
+``python tools/flight_smoke.py [dump_dir]``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DUMP_DIR = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mpit_flight_smoke"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Enable obs + flight dumps BEFORE any role object captures the registry.
+os.environ["MPIT_OBS"] = "1"
+os.environ["MPIT_OBS_FLIGHT"] = DUMP_DIR
+os.makedirs(DUMP_DIR, exist_ok=True)
+
+import numpy as np  # noqa: E402
+
+from mpit_tpu import obs  # noqa: E402
+from mpit_tpu.comm.local import LocalRouter  # noqa: E402
+from mpit_tpu.ft import FaultPlan, FaultyTransport, FTConfig  # noqa: E402
+from mpit_tpu.obs import flight as obs_flight  # noqa: E402
+from mpit_tpu.obs import statusd as obs_statusd  # noqa: E402
+from mpit_tpu.ps import ParamClient, ParamServer  # noqa: E402
+
+FT = FTConfig(op_deadline_s=0.2, max_retries=3,
+              backoff_base_s=0.01, backoff_cap_s=0.05, staleness=True)
+SIZE = 1024
+WARM_ROUNDS = 3
+
+
+def main() -> int:
+    router = LocalRouter(4)
+    sranks, cranks = [0, 1], [2, 3]
+    servers = [ParamServer(r, cranks, router.endpoint(r), rule="add",
+                           ft=FTConfig(rejoin=True)) for r in sranks]
+    threads = [threading.Thread(target=s.start, daemon=True)
+               for s in servers]
+    for t in threads:
+        t.start()
+    faulty = FaultyTransport(router.endpoint(cranks[0]), FaultPlan())
+    clients = [
+        ParamClient(cranks[0], sranks, faulty, seed_servers=True, ft=FT),
+        ParamClient(cranks[1], sranks, router.endpoint(cranks[1]), ft=FT),
+    ]
+    # One live endpoint for the client rank (the gang shares a process
+    # here; per-rank processes each get their own in a real launch).
+    statusd = obs_statusd.StatusServer(0, rank=cranks[0], role="worker")
+    obs_flight.get_flight().set_identity(rank=cranks[0], role="worker")
+    starters = [threading.Thread(
+        target=c.start,
+        args=(np.zeros(SIZE, np.float32), np.zeros(SIZE, np.float32)),
+        daemon=True) for c in clients]
+    for t in starters:
+        t.start()
+    for t in starters:
+        t.join(60)
+        assert not t.is_alive(), "client start hung"
+
+    rng = np.random.default_rng(3)
+    for _ in range(WARM_ROUNDS):
+        for c in clients:
+            c.async_recv_param()
+            c.wait()
+        for c in clients:
+            c.grad[:] = rng.normal(size=SIZE).astype(np.float32)
+            c.async_send_grad()
+            c.wait()
+
+    # 1. the live endpoint serves while the gang runs
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{statusd.port}/metrics", timeout=5) as resp:
+        exposition = resp.read().decode()
+    assert "mpit_ft_retries_total" in exposition, "exposition missing counters"
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{statusd.port}/status", timeout=5) as resp:
+        status = json.loads(resp.read())
+    assert status["rank"] == cranks[0] and "inflight_ops" in status
+    print(f"[flight_smoke] /metrics + /status live on :{statusd.port}")
+
+    # 2. sever client 0 from every server -> RetryExhausted, never a hang
+    for s in sranks:
+        faulty.sever(s)
+    failed = False
+    try:
+        clients[0].grad[:] = 1.0
+        clients[0].async_send_grad()
+        clients[0].wait()
+    except Exception as exc:  # noqa: BLE001 — TaskError(RetryExhausted)
+        failed = True
+        print(f"[flight_smoke] sever surfaced loudly: {exc!r}")
+    assert failed, "severed GRAD did not fail"
+
+    # 3. the failure dumped the flight recorder; dump validates
+    fl = obs_flight.get_flight()
+    assert fl.last_dump_path, "no flight dump written"
+    stats = obs_flight.validate_dump(fl.last_dump_path)
+    assert stats["reason"] == "retry_exhausted", stats
+    assert stats["events"] > 0 and stats["metrics"] > 0
+    obj = json.load(open(fl.last_dump_path))
+    assert any(ev["kind"] == "retry_exhausted" for ev in obj["events"])
+    cli = subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.obs", "flight", fl.last_dump_path],
+        capture_output=True, text=True)
+    assert cli.returncode == 0, cli.stdout + cli.stderr
+    print(f"[flight_smoke] dump ok: {cli.stdout.strip()}")
+
+    # 4. staleness histograms populated before the sever
+    snap = obs.get_registry().snapshot()
+    stale = {k: v for k, v in snap.items()
+             if k.startswith("mpit_ps_grad_staleness")}
+    assert stale, "no staleness histograms recorded"
+    total = sum(v["count"] for v in stale.values())
+    assert total == WARM_ROUNDS * len(clients) * len(sranks), (total, stale)
+    print(f"[flight_smoke] staleness observations: {total} "
+          f"across {len(stale)} (client, server) pairs")
+
+    # teardown: stop everything (client 0 is dead air to the servers now)
+    clients[1].stop()
+    for role in clients + servers:
+        role.live.stop()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "server thread hung at teardown"
+    statusd.close()
+    print("[flight_smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
