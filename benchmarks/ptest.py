@@ -28,7 +28,9 @@ default procs), MPIT_PS_CODEC (wire codec for the shm leg —
 comm/codec.py), and MPIT_BENCH_CODECS (comma list, e.g.
 "none,bf16,int8": run the shm leg once per codec — the codec A/B sweep,
 docs/PROTOCOL.md §5).  MPIT_BENCH_REPS (default 1 here) repeats each
-shm leg and reports the median + per-run values.
+shm leg and reports the median + per-run values.  MPIT_BENCH_DECOMP=1
+adds a causally-traced leg whose row carries per-phase p50/p99 latency
+from `obs analyze` (docs/OBSERVABILITY.md, *Causal op tracing*).
 
 Prints one JSON line per mode (and per codec in a sweep): MB/s
 bi-directional, plus per-chip for the ici mode.  MB/s counts *logical*
@@ -89,6 +91,18 @@ STATUS_PORT = int(os.environ.get("MPIT_BENCH_STATUS_PORT", "8390"))
 # dominates, so the column pair measures what the rebalancer is worth
 # under skew (docs/PROTOCOL.md §7.6; ISSUE 5 bar: on >= 1.2x off).
 SKEW_SWEEP = os.environ.get("MPIT_BENCH_SKEW", "") not in ("", "0")
+# MPIT_BENCH_DECOMP=1: run one extra codec=none leg with the causal
+# tracing surface fully on — obs + Chrome-trace parts in every child,
+# the framed wire with FLAG_TIMING (clock-offset tails, PROTOCOL.md
+# §6.7) — then merge the per-rank parts and run the causal analyzer
+# (obs/causal.py) on the gang's own trace: per-phase p50/p99 latency
+# (encode/send-queue/wire/server-queue/apply/ack-wire/...) lands in the
+# BENCH json next to MB/s.  The leg runs the *framed* wire (a protocol
+# mode with a known staging-copy cost, like the skew legs), so it is
+# excluded from the codec=none baseline gate; the plain codec=none leg
+# in the same sweep still must clear it.
+DECOMP_SWEEP = os.environ.get("MPIT_BENCH_DECOMP", "") not in ("", "0")
+DECOMP_DEADLINE = float(os.environ.get("MPIT_BENCH_DECOMP_DEADLINE", "120"))
 # 600 polls per reply ~ hundreds of ms of straggle per ack at bench
 # scale — enough to dominate a round (40 was invisible next to a
 # multi-MB shard transfer, measured off==on within noise).
@@ -120,7 +134,7 @@ def bench_ici() -> dict:
 
 def bench_shm(codec: str = "", heartbeat: bool = False,
               obs: bool = False, skew_rebalance=None,
-              status: bool = False) -> dict:
+              status: bool = False, decomp: bool = False) -> dict:
     """One shm PS push/pull measurement; ``codec`` overrides
     MPIT_PS_CODEC for the gang (read at client/server construction);
     ``heartbeat`` arms client beacons + the server lease registry;
@@ -130,7 +144,9 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
     parent poller scrapes rank 0's /metrics throughout the run;
     ``skew_rebalance`` (None = no skew) delay-injects the last server's
     replies and runs the gang in shardctl mode with the rebalance policy
-    off (False) or on (True)."""
+    off (False) or on (True); ``decomp`` arms the causal-tracing column:
+    framed FLAG_TIMING wire + per-rank trace parts, merged and fed
+    through ``obs analyze`` so the row carries per-phase p50/p99."""
     import numpy as np
 
     from mpit_tpu.comm import codec as codec_mod
@@ -147,18 +163,20 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
             if skew_rebalance is not None else "")
          + f"payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
 
-    if (heartbeat or obs or status) and GANG != "procs":
+    if (heartbeat or obs or status or decomp) and GANG != "procs":
         raise RuntimeError(
-            "MPIT_BENCH_HEARTBEAT/MPIT_BENCH_OBS/MPIT_BENCH_STATUS need "
-            "MPIT_BENCH_GANG=procs")
+            "MPIT_BENCH_HEARTBEAT/MPIT_BENCH_OBS/MPIT_BENCH_STATUS/"
+            "MPIT_BENCH_DECOMP need MPIT_BENCH_GANG=procs")
     if skew_rebalance is not None and GANG != "procs":
         raise RuntimeError("MPIT_BENCH_SKEW needs MPIT_BENCH_GANG=procs")
     polls = [0]
+    decomp_out: dict = {}
     if GANG == "procs":
         runs = [_shm_run_procs(size, heartbeat=heartbeat, obs=obs,
                                skew_rebalance=skew_rebalance,
                                status_port=STATUS_PORT if status else None,
-                               status_polls=polls)
+                               status_polls=polls,
+                               decomp_out=decomp_out if decomp else None)
                 for _ in range(REPS)]
     else:
         runs = [_shm_run_threads(size, heartbeat=heartbeat)
@@ -183,6 +201,12 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
     if status:
         row["status"] = 1
         row["status_polls"] = polls[0]
+    if decomp:
+        # Per-phase latency decomposition from the last rep's analyzed
+        # trace (ms; obs/causal.py) — the "where does an op's time go"
+        # column next to the MB/s it cost to measure it.
+        row["decomp"] = 1
+        row.update(decomp_out)
     if skew_rebalance is not None:
         row["skew"] = 1
         row["rebalance"] = int(bool(skew_rebalance))
@@ -224,7 +248,8 @@ def _status_poller(port: int, stop, polls) -> None:
 
 def _shm_run_procs(size: int, heartbeat: bool = False,
                    obs: bool = False, skew_rebalance=None,
-                   status_port=None, status_polls=None) -> float:
+                   status_port=None, status_polls=None,
+                   decomp_out=None) -> float:
     """One timed gang, one OS process per rank: servers run the PS serve
     loop, clients run T rounds of {pull, push, wait} and report their
     round-loop window; aggregate MB/s uses the union of the client
@@ -243,6 +268,11 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
         "size": size, "ring": _ring_bytes(size), "rounds": ROUNDS,
         "heartbeat": int(heartbeat),
     }
+    if decomp_out is not None:
+        # Causal-tracing leg: the framed FLAG_TIMING wire (generous
+        # deadline — a spurious retry at bench scale would corrupt the
+        # measured column) + a per-rank trace part from every child.
+        spec["decomp"] = {"deadline_s": DECOMP_DEADLINE}
     if skew_rebalance is not None:
         spec["skew"] = {"slow_server": NSERVERS - 1,
                         "delay_polls": SKEW_POLLS,
@@ -262,6 +292,9 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
             MPIT_OBS="1" if obs else "0",
         )
         env.pop("MPIT_OBS_TRACE", None)  # tracing implies obs; keep A/B clean
+        if decomp_out is not None:
+            env["MPIT_OBS"] = "1"
+            env["MPIT_OBS_TRACE"] = os.path.join(tmpdir, "decomp_trace.json")
         if status_port is not None:
             env["MPIT_OBS_HTTP"] = str(status_port)
         else:
@@ -321,6 +354,10 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
             rec = json.load(fh)
         windows.append((rec["t0"], rec["t1"]))
     dt = max(w[1] for w in windows) - min(w[0] for w in windows)
+    if decomp_out is not None:
+        decomp_out.clear()
+        decomp_out.update(_analyze_gang_trace(
+            os.path.join(tmpdir, "decomp_trace.json")))
     import shutil
 
     shutil.rmtree(tmpdir, ignore_errors=True)
@@ -328,6 +365,42 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
     _log(f"[shm] {ROUNDS} rounds x {NCLIENTS} client procs in {dt:.3f}s "
          f"-> {mbs:.1f} MB/s aggregate")
     return mbs
+
+
+def _analyze_gang_trace(base: str) -> dict:
+    """Merge the gang's per-rank trace parts and run the causal
+    analyzer: per-(op, phase) p50/p99 in ms plus the join rate — the
+    MPIT_BENCH_DECOMP column's payload.  Fails loudly when the parts
+    are missing or the analyzer finds violations (a broken decomposition
+    must not be captured as a bench column)."""
+    import glob
+
+    from mpit_tpu.obs import causal as obs_causal
+    from mpit_tpu.obs import trace as obs_trace
+
+    parts = sorted(glob.glob(f"{base}.rank*.json"))
+    if not parts:
+        raise RuntimeError(
+            "MPIT_BENCH_DECOMP leg completed but no trace parts were "
+            "written — the children never exported (fake column)")
+    obs_trace.merge_traces(base, parts)
+    report = obs_causal.analyze(base)
+    if report["violations"]:
+        raise RuntimeError(
+            f"MPIT_BENCH_DECOMP analyzer found {len(report['violations'])} "
+            f"negative-phase violation(s): {report['violations'][:3]}")
+    phases = {}
+    for op, st in report["phase_stats"].items():
+        phases[op] = {
+            phase: {"p50_ms": round(p["p50_us"] / 1000.0, 3),
+                    "p99_ms": round(p["p99_us"] / 1000.0, 3)}
+            for phase, p in st["phases"].items() if p["total_us"] > 0
+        }
+    return {
+        "phases": phases,
+        "join_rate": round(report["ops"]["join_rate"], 4),
+        "joined_ops": report["ops"]["joined"],
+    }
 
 
 def _gang_child() -> None:
@@ -368,6 +441,13 @@ def _gang_child() -> None:
     # production-tight TTL evicts a live client mid-leg and wedges it.
     client_ft = FTConfig(heartbeat_s=0.05) if heartbeat else FTConfig()
     server_ft = FTConfig(lease_ttl_s=120.0) if heartbeat else FTConfig()
+    decomp = spec.get("decomp")
+    if decomp:
+        # Causal-tracing leg: framed wire + FLAG_TIMING tails.  The
+        # deadline is deliberately huge — this column measures where an
+        # op's time goes, not the retry machinery.
+        client_ft = FTConfig(op_deadline_s=float(decomp["deadline_s"]),
+                             timing=True)
     if skew:
         # Shardctl mode: framed ops with a deadline sized for the leg's
         # delayed straggler replies, beats for the controller's window.
@@ -451,6 +531,11 @@ def _gang_child() -> None:
         t1 = time.time()
         client.stop()
         result = {"role": "client", "t0": t0, "t1": t1}
+    # Per-rank Chrome-trace part (no-op unless MPIT_OBS_TRACE rode in —
+    # the MPIT_BENCH_DECOMP column); the parent merges + analyzes.
+    from mpit_tpu.obs import maybe_write_rank_trace
+
+    maybe_write_rank_trace(rank, role=str(result.get("role", "")))
     transport.close()
     with open(os.environ["PTEST_RESULT"], "w") as fh:
         json.dump(result, fh)
@@ -550,6 +635,12 @@ def main():
         # the row joins the baseline gate — serving scrapes must not
         # cost the record.
         results.append(bench_shm("none", obs=True, status=True))
+    if DECOMP_SWEEP and MODE in ("shm", "both"):
+        # Causal-decomposition leg: traced FLAG_TIMING gang, analyzed;
+        # per-phase p50/p99 lands in the row.  Framed wire => excluded
+        # from the codec=none gate (a different protocol mode, like
+        # skew); the plain codec=none leg above still holds the record.
+        results.append(bench_shm("none", decomp=True))
     if SKEW_SWEEP and MODE in ("shm", "both"):
         # The straggler A/B runs at codec=none (the skew is in the
         # *reply latency*, not the byte volume): rebalance off, then on.
@@ -561,7 +652,8 @@ def main():
         low = [
             r for r in results
             if r.get("codec") == "none" and r["metric"].endswith("_shm")
-            and not r.get("skew") and r["value"] < 0.97 * BASELINE
+            and not r.get("skew") and not r.get("decomp")
+            and r["value"] < 0.97 * BASELINE
         ]
         if low:
             raise SystemExit(

@@ -29,6 +29,12 @@ the Chrome trace.  Two rules:
   and dashboards built from the catalog silently miss it.  Trees
   without the doc skip the rule (fixture packages opt in by shipping
   one).
+- **MT-O404** — undocumented span phase: every string literal passed to
+  the span phase API (``span.mark("...")``) must appear in
+  ``docs/OBSERVABILITY.md``'s phase taxonomy (same scan-root-relative
+  doc lookup as MT-O403).  The causal analyzer (obs/causal.py) and
+  every trace reader key on phase names; a phase the taxonomy doesn't
+  list decomposes to nothing and silently skews the attribution.
 """
 
 from __future__ import annotations
@@ -188,6 +194,44 @@ def _check_metric_catalog(files: List[SourceFile],
                     "mpit_* instrument must carry a catalog row"))
 
 
+def _check_phase_catalog(files: List[SourceFile],
+                         findings: List[Finding]) -> None:
+    """MT-O404: every span-phase literal (``.mark("phase")``) must
+    appear in the docs/OBSERVABILITY.md phase taxonomy.  Whole-tree
+    scope like MT-O403 (spans are marked from ps/, ft/ and shardctl
+    call sites alike); one finding per (file, phase)."""
+    import re
+
+    doc = _find_catalog(files)
+    if doc is None:
+        return
+    seen: Set[Tuple[str, str]] = set()
+    for src in files:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "mark"
+                    and len(node.args) == 1
+                    and not node.keywords):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            key = (src.rel, arg.value)
+            if key in seen:
+                continue
+            seen.add(key)
+            if not re.search(rf"\b{re.escape(arg.value)}\b", doc):
+                findings.append(src.finding(
+                    "MT-O404", node,
+                    f"span phase {arg.value!r} is marked here but absent "
+                    "from the docs/OBSERVABILITY.md phase taxonomy — the "
+                    "causal analyzer and trace readers key on phase "
+                    "names, so every mark() literal must carry a "
+                    "taxonomy row"))
+
+
 def check(files: List[SourceFile]) -> List[Finding]:
     findings: List[Finding] = []
     for src in files:
@@ -197,4 +241,5 @@ def check(files: List[SourceFile]) -> List[Finding]:
         for qual, body in _scopes(src.tree):
             _check_scope(src, qual, body, seen, findings)
     _check_metric_catalog(files, findings)
+    _check_phase_catalog(files, findings)
     return findings

@@ -203,6 +203,13 @@ class TcpTransport(Transport):
         self._m_rx_bytes = [_reg.counter("mpit_tcp_rx_bytes_total",
                                          rank=rank, peer=r)
                             for r in range(nranks)]
+        # Send-queue depth (frames queued to each peer's writer) — the
+        # live queueing-pressure signal `mpit top` renders: a peer whose
+        # writer cannot drain shows a growing depth long before ops
+        # start missing deadlines.
+        self._m_sendq = [_reg.gauge("mpit_tcp_send_queue_depth",
+                                    rank=rank, peer=r)
+                         for r in range(nranks)]
 
         host, _, port = addresses[rank].rpartition(":")
         if listener is None:
@@ -702,6 +709,7 @@ class TcpTransport(Transport):
                 # and retaining here would corrupt _unacked's ordering.
                 if box and box[0] is entry:
                     box.popleft()
+                    self._m_sendq[peer].set(len(box))
                     popped = True
                     if (retain_seq is not None and self.reconnect > 0
                             and retain_seq > self._acked_high[peer]):
@@ -731,6 +739,7 @@ class TcpTransport(Transport):
                     h.buf = None
                     if error:
                         h.meta["error"] = error
+            self._m_sendq[peer].set(0)
 
     # -- Transport -----------------------------------------------------------
 
@@ -758,6 +767,7 @@ class TcpTransport(Transport):
                 (handle, _HDR.pack(tag, view.nbytes, self._send_seq[dst]),
                  view, self._send_seq[dst])
             )
+            self._m_sendq[dst].set(len(self._outboxes[dst]))
             cv.notify()
         self._m_tx_msgs[dst].inc()
         self._m_tx_bytes[dst].inc(view.nbytes)

@@ -29,16 +29,26 @@ from mpit_tpu.ft.faults import FaultPlan, FaultyTransport
 from mpit_tpu.ft.leases import ACTIVE, EVICTED, STOPPED, LeaseRegistry
 from mpit_tpu.ft.retry import RetryExhausted, RetryPolicy
 from mpit_tpu.ft.wire import (
+    ACK_TIMING_WORDS,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
     FLAG_STALENESS,
+    FLAG_TIMING,
     HDR_BYTES,
     HDR_STALE_BYTES,
+    TIMING_TAIL_BYTES,
+    hdr_bytes,
     header_frame,
     init_v3,
     pack_header,
+    pack_reply_stamps,
+    pack_tx_stamp,
     pack_version,
+    reply_hdr_bytes,
+    timed_frame,
     unpack_header,
+    unpack_reply_stamps,
+    unpack_tx_stamp,
     unpack_version,
 )
 
@@ -49,7 +59,11 @@ __all__ = [
     "LeaseRegistry", "ACTIVE", "EVICTED", "STOPPED",
     "RetryPolicy", "RetryExhausted",
     "HDR_BYTES", "HDR_STALE_BYTES",
-    "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_STALENESS",
-    "pack_header", "unpack_header", "header_frame", "init_v3",
-    "pack_version", "unpack_version",
+    "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_STALENESS", "FLAG_TIMING",
+    "ACK_TIMING_WORDS", "TIMING_TAIL_BYTES",
+    "hdr_bytes", "reply_hdr_bytes",
+    "pack_header", "unpack_header", "header_frame", "timed_frame",
+    "init_v3", "pack_version", "unpack_version",
+    "pack_tx_stamp", "unpack_tx_stamp",
+    "pack_reply_stamps", "unpack_reply_stamps",
 ]

@@ -51,6 +51,13 @@ class FTConfig:
     #: (op_deadline_s > 0); silently inactive otherwise, and negotiated
     #: off per pair for legacy peers exactly like framing itself.
     staleness: bool = False
+    #: client: announce FLAG_TIMING — frames carry a send stamp and every
+    #: ack/reply a [t_tx_echo, t_recv, t_ack] tail, feeding the per-peer
+    #: clock-offset estimator and the causal latency decomposition
+    #: (obs/clock.py, obs/causal.py; PROTOCOL.md §6.7).  Requires
+    #: framing; silently inactive otherwise, negotiated off per pair for
+    #: legacy peers exactly like staleness.
+    timing: bool = False
 
     @property
     def active(self) -> bool:
@@ -67,6 +74,11 @@ class FTConfig:
     def stale_track(self) -> bool:
         """Staleness telemetry is live: framed + requested."""
         return self.framed and self.staleness
+
+    @property
+    def timing_track(self) -> bool:
+        """Causal-timing telemetry is live: framed + requested."""
+        return self.framed and self.timing
 
     @property
     def server_rejoin(self) -> bool:
@@ -93,6 +105,7 @@ class FTConfig:
             rejoin=os.environ.get("MPIT_FT_REJOIN", "0") not in ("0", ""),
             staleness=os.environ.get("MPIT_FT_STALENESS", "0")
             not in ("0", ""),
+            timing=os.environ.get("MPIT_FT_TIMING", "0") not in ("0", ""),
         )
         fields.update(overrides)
         return cls(**fields)

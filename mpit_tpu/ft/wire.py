@@ -61,6 +61,40 @@ FLAG_HEARTBEAT = 2
 #: meaningful alongside FLAG_FRAMED (staleness needs the op identity).
 FLAG_STALENESS = 4
 
+#: INIT v3 flags bit3: the causal-timing extension (docs/PROTOCOL.md
+#: §6.7).  Client→server frames append one int64 word — the client's
+#: wall-µs send stamp (re-stamped per retry attempt) — and every ack /
+#: reply grows a three-word tail ``[t_tx_echo, t_recv, t_ack]``: the
+#: echoed client stamp plus the server's receive and ack-send stamps.
+#: Echoing t_tx is what makes the NTP exchange retry-safe: the tail
+#: pairs with the *attempt the server actually saw*, and a stale
+#: pairing just looks slow to the minimum-RTT filter (obs/clock.py).
+#: Negotiated per pair like the other bits; requires FLAG_FRAMED and is
+#: off under shardctl (the 32-byte shard header has no stamp slot).
+FLAG_TIMING = 8
+
+#: the timing tail: int64 [t_tx_echo_us, t_recv_us, t_ack_us]
+TIMING_TAIL_WORDS = 3
+TIMING_TAIL_BYTES = 8 * TIMING_TAIL_WORDS
+
+#: timing acks (GRAD_ACK / PARAM_PUSH_ACK / HEARTBEAT_ECHO): int64
+#: [epoch, seq, t_tx_echo, t_recv, t_ack]
+ACK_TIMING_WORDS = 5
+
+
+def hdr_bytes(stale: bool, timing: bool) -> int:
+    """Client→server data-frame header size for a negotiated pair:
+    [epoch, seq] (+version under FLAG_STALENESS) (+t_tx under
+    FLAG_TIMING, always the last word)."""
+    return HDR_BYTES + (8 if stale else 0) + (8 if timing else 0)
+
+
+def reply_hdr_bytes(stale: bool, timing: bool) -> int:
+    """PARAM-reply header size: [epoch, seq] (+version) (+ the
+    three-word timing tail)."""
+    return HDR_BYTES + (8 if stale else 0) + \
+        (TIMING_TAIL_BYTES if timing else 0)
+
 
 def pack_header(buf: np.ndarray, epoch: int, seq: int) -> None:
     """Write the [epoch, seq] header into the first HDR_BYTES of a uint8
@@ -85,9 +119,41 @@ def unpack_version(buf: np.ndarray) -> int:
     return int(buf[HDR_BYTES:HDR_STALE_BYTES].view(np.int64)[0])
 
 
+def pack_tx_stamp(buf: np.ndarray, hdr: int, t_us: int) -> None:
+    """Write the FLAG_TIMING send stamp into the *last* header word of a
+    uint8 staging buffer whose header is ``hdr`` bytes (ft retries
+    re-stamp this word per attempt — the body bytes stay identical)."""
+    buf[hdr - 8:hdr].view(np.int64)[0] = t_us
+
+
+def unpack_tx_stamp(buf: np.ndarray, hdr: int) -> int:
+    """The send-stamp word of a timing header (see pack_tx_stamp)."""
+    return int(buf[hdr - 8:hdr].view(np.int64)[0])
+
+
+def pack_reply_stamps(buf: np.ndarray, base: int, t_tx: int, t_recv: int,
+                      t_ack: int) -> None:
+    """Write the three-word timing tail of a PARAM reply at byte offset
+    ``base`` (= 16, or 24 when the pair also tracks staleness)."""
+    buf[base:base + TIMING_TAIL_BYTES].view(np.int64)[:] = (
+        t_tx, t_recv, t_ack)
+
+
+def unpack_reply_stamps(buf: np.ndarray, base: int):
+    """(t_tx_echo, t_recv, t_ack) from a PARAM reply's timing tail."""
+    tail = buf[base:base + TIMING_TAIL_BYTES].view(np.int64)
+    return int(tail[0]), int(tail[1]), int(tail[2])
+
+
 def header_frame(epoch: int, seq: int) -> np.ndarray:
     """A fresh 16-byte header-only message (acks, PARAM_REQ, HEARTBEAT)."""
     return np.asarray([epoch, seq], dtype=np.int64)
+
+
+def timed_frame(epoch: int, seq: int, t_us: int) -> np.ndarray:
+    """A 24-byte [epoch, seq, t_tx] message — FLAG_TIMING PARAM_REQ and
+    HEARTBEAT beacons."""
+    return np.asarray([epoch, seq, t_us], dtype=np.int64)
 
 
 def init_v3(offset: int, size: int, codec_id: int, epoch: int,

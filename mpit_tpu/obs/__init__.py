@@ -38,7 +38,16 @@ counters.  This package is the one place the stack reports through:
   nothing.
 - :mod:`mpit_tpu.obs.top` — ``python -m mpit_tpu.obs top``: a gang-wide
   aggregator polling every rank's endpoint into one table (throughput,
-  staleness, retries, shard load).
+  staleness, retries, shard load, p99 op latency, send-queue depth).
+- :mod:`mpit_tpu.obs.clock` — the process time base plus the per-peer
+  **clock-offset estimator** fed by the FLAG_TIMING wire extension
+  (NTP-style minimum-RTT exchanges over op acks and heartbeat echoes).
+- :mod:`mpit_tpu.obs.causal` — ``python -m mpit_tpu.obs analyze``: the
+  offline **causal joiner**: merges per-rank trace halves into op
+  chains keyed by wire identity, aligns rank clocks, decomposes each
+  op's latency onto the encode → send-queue → wire → server-queue →
+  apply → ack-wire → client-wait taxonomy, reports per-phase
+  percentiles and the critical path, and emits Perfetto flow arrows.
 
 Enablement: ``MPIT_OBS=1`` (or ``MPIT_OBS_TRACE=<path>``, which implies
 it) turns the global registry + recorder on; :func:`configure` does the
@@ -47,6 +56,7 @@ construction, so enable *before* building transports/roles.  See
 docs/OBSERVABILITY.md for the metric catalog and trace schema.
 """
 
+from mpit_tpu.obs.clock import ClockEstimator, PeerClock, wall_us
 from mpit_tpu.obs.flight import (
     NULL_FLIGHT,
     FlightRecorder,
@@ -94,4 +104,5 @@ __all__ = [
     "write_rank_trace", "merge_traces", "validate_trace",
     "maybe_write_rank_trace", "maybe_merge_rank_traces",
     "PhaseTimers", "trace_annotation", "profiler_trace",
+    "ClockEstimator", "PeerClock", "wall_us",
 ]

@@ -15,6 +15,12 @@ Subcommands:
   from every rank's statusd endpoint (obs/top.py).
 - ``flight <dump.json>...`` — validate flight-recorder dumps
   (obs/flight.py schema).
+- ``analyze <trace.json> [--json] [--min-join F] [--emit-flow PATH]``
+  — join the client and server halves of every framed op into causal
+  chains, align rank clocks, decompose per-op latency onto the phase
+  taxonomy and report the critical path (obs/causal.py).  Exit 1 on
+  negative phase durations beyond clock uncertainty or a join rate
+  below ``--min-join`` — the CI obs-trace job gates on both.
 """
 
 import glob as _glob
@@ -83,6 +89,10 @@ def main(argv=None) -> int:
         return top_main(argv[1:])
     if argv and argv[0] == "flight":
         return _flight_main(argv[1:])
+    if argv and argv[0] == "analyze":
+        from mpit_tpu.obs.causal import main as analyze_main
+
+        return analyze_main(argv[1:])
     if argv and argv[0] == "validate":
         argv = argv[1:]
     from mpit_tpu.obs.trace import main as validate_main

@@ -41,6 +41,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from mpit_tpu.obs import clock as _clock
 from mpit_tpu.obs import metrics as _metrics
 
 ENV_DIR = "MPIT_OBS_FLIGHT"
@@ -84,7 +85,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = CAPACITY):
         self.events: deque = deque(maxlen=capacity)
-        self.epoch_offset = time.time() - time.monotonic()
+        self.epoch_offset = _clock.epoch_offset()  # the shared time base
         self.rank: Optional[int] = None
         self.role: str = ""
         self.last_dump_path: Optional[str] = None
@@ -137,7 +138,13 @@ class FlightRecorder:
                 for t, kind, fields in list(self.events)
             ],
             "tasks": [list(t) for t in tasks] if tasks is not None else None,
+            # The open causal chains: each in-flight op's wall-anchored
+            # phase-mark history plus any echoed server stamps in its
+            # args — a hang postmortem names the phase the op died in.
             "inflight_ops": rec.open_ops(),
+            # Per-peer clock-offset estimates (obs/clock.py), so the
+            # chain above maps onto a sibling rank's dump/trace.
+            "clock": _clock.snapshot_all(),
             "metrics": _metrics.get_registry().snapshot(),
         }
         if extra:

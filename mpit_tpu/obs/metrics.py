@@ -373,8 +373,9 @@ def configure(enabled: Optional[bool] = None, reset: bool = False) -> None:
     _FORCED = enabled
     if reset:
         _GLOBAL = Registry()
-        from mpit_tpu.obs import flight, spans, statusd
+        from mpit_tpu.obs import clock, flight, spans, statusd
 
         spans.reset()
         flight.reset()
         statusd.clear_providers()
+        clock.reset()

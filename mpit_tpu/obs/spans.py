@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from mpit_tpu.obs import clock as _clock
 from mpit_tpu.obs import flight as _flight
 from mpit_tpu.obs import metrics as _metrics
 
@@ -102,8 +103,11 @@ class SpanRecorder:
             else _metrics.get_registry()
         self.spans: List[OpSpan] = []
         self.tasks: List[Tuple[str, float, float, str]] = []
-        #: monotonic -> wall offset for cross-rank trace merging
-        self.epoch_offset = time.time() - time.monotonic()
+        #: monotonic -> wall offset for cross-rank trace merging — the
+        #: process-wide time base (obs/clock.py), shared with the flight
+        #: recorder and the FLAG_TIMING wire stamps so every timestamp
+        #: this process emits subtracts cleanly against the others.
+        self.epoch_offset = _clock.epoch_offset()
         self.flight = _flight.get_flight()
         self._hist_lock = threading.Lock()
         self._hists: Dict[Tuple[str, str], object] = {}
@@ -115,26 +119,38 @@ class SpanRecorder:
     def op(self, name: str, peer: object = "?", side: str = "client",
            **args) -> OpSpan:
         """Begin an op span.  ``tid`` groups ops into trace rows — one
-        per (side, peer, tag) channel, which the protocol already keeps
-        strictly sequential (client pump FIFO, per-channel server
-        loops), so begin/end events nest cleanly."""
+        per (role rank, side, peer, tag) channel, which the protocol
+        already keeps strictly sequential (client pump FIFO, per-channel
+        server loops), so begin/end events nest cleanly.  The role's own
+        rank (``rank=`` arg) is part of the channel id: in a
+        single-process multi-role gang (thread tests, np=1) two servers
+        otherwise share e.g. ``server:2:GRAD`` and their interleaved
+        B/E events scramble the channel."""
         args["peer"] = peer
         args["side"] = side
-        span = OpSpan(self, name, f"{side}:{peer}:{name}", args)
+        rank = args.get("rank")
+        prefix = f"r{rank}:" if rank is not None else ""
+        span = OpSpan(self, name, f"{prefix}{side}:{peer}:{name}", args)
         self._open[id(span)] = span
         return span
 
     def open_ops(self) -> List[Dict[str, object]]:
         """Snapshot of the in-flight ops: identity args, current phase,
-        and seconds in flight so far (one clock read per request — this
-        runs on the introspection path, never the hot path)."""
+        the full wall-anchored phase-mark chain (the open half of the
+        op's causal chain — a flight dump can say which phase an op died
+        in and line it up against a sibling rank's timeline), and
+        seconds in flight so far (one clock read per request — this runs
+        on the introspection path, never the hot path)."""
         now = time.monotonic()
+        off = self.epoch_offset
         out = []
         for span in list(self._open.values()):
             out.append({
                 "op": span.name,
                 "elapsed_s": now - span.t0,
                 "phase": span.marks[-1][0] if span.marks else "",
+                "t0": span.t0 + off,
+                "marks": [[phase, t + off] for phase, t in list(span.marks)],
                 **{k: v for k, v in span.args.items()},
             })
         return out

@@ -40,6 +40,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from mpit_tpu.obs import clock as _clock
 from mpit_tpu.obs import metrics as _metrics
 from mpit_tpu.obs import spans as _spans
 
@@ -132,6 +133,7 @@ class StatusServer:
             "pid": os.getpid(),
             "obs": _metrics.obs_enabled(),
             "inflight_ops": rec.open_ops(),
+            "clock": _clock.snapshot_all(),
             **_provider_sections(),
         }
 
@@ -148,7 +150,8 @@ class StatusServer:
             "displayTimeUnit": "ms",
             "otherData": {"ranks": {str(pid): {
                 "role": self.role,
-                "metrics": _metrics.get_registry().snapshot()}}},
+                "metrics": _metrics.get_registry().snapshot()}},
+                "clock": _clock.snapshot_all()},
         }
 
     def close(self) -> None:

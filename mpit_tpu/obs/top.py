@@ -82,6 +82,38 @@ def hist_mean(samples, name: str) -> Optional[float]:
     return metric_sum(samples, name + "_sum") / count
 
 
+def hist_quantile(samples, name: str, q: float) -> Optional[float]:
+    """Quantile estimate from a histogram's cumulative ``_bucket{le=}``
+    series (all label sets pooled): the smallest bucket upper bound
+    whose pooled cumulative count covers rank ``q``.  Exact up to the
+    log2 bucket width; None when the histogram never observed."""
+    per_le: Dict[float, float] = {}
+    for n, labels, value in samples:
+        if n != name + "_bucket":
+            continue
+        le = labels.get("le", "")
+        bound = float("inf") if le == "+Inf" else float(le)
+        # Cumulative series pool by summing per bound across label sets.
+        per_le[bound] = per_le.get(bound, 0.0) + value
+    if not per_le:
+        return None
+    total = metric_sum(samples, name + "_count")
+    if total <= 0:
+        return None
+    target = q * total
+    best = None
+    for bound in sorted(per_le):
+        if per_le[bound] >= target:
+            best = bound
+            break
+    if best is None or best == float("inf"):
+        # Everything above the largest finite bucket: report the max
+        # finite bound (the histogram clamps there too).
+        finite = [b for b in per_le if b != float("inf")]
+        best = max(finite) if finite else None
+    return best
+
+
 def _get(url: str, timeout: float) -> bytes:
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read()
@@ -125,6 +157,12 @@ def _rank_row(rank: int, sample: Optional[dict],
         "ops_total": int(ops),
         "ops_per_s": None,
         "staleness_mean": hist_mean(m, "mpit_ps_grad_staleness"),
+        # Queueing-pressure columns: p99 op latency from the
+        # mpit_ps_op_seconds log2 buckets, and the frames still queued
+        # to writer threads (tcp gangs; shm sends complete into the
+        # ring, so the column reads 0 there).
+        "p99_s": hist_quantile(m, "mpit_ps_op_seconds", 0.99),
+        "send_queue": int(metric_sum(m, "mpit_tcp_send_queue_depth")),
         "retries": int(metric_sum(m, "mpit_ft_retries_total")),
         "evictions": int(metric_sum(m, "mpit_ft_evictions_total")),
         "shards": int(metric_sum(m, "mpit_shardctl_owned_shards")),
@@ -140,8 +178,8 @@ def _rank_row(rank: int, sample: Optional[dict],
     return row
 
 
-_COLUMNS = ("rank", "role", "ops", "ops/s", "stale", "retry", "evict",
-            "shards", "busy_s", "mapv", "infl")
+_COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "sendq", "stale",
+            "retry", "evict", "shards", "busy_s", "mapv", "infl")
 
 
 def render_table(rows: List[Dict[str, object]]) -> str:
@@ -150,10 +188,13 @@ def render_table(rows: List[Dict[str, object]]) -> str:
             return [str(row["rank"]), "(down)"] + ["-"] * (len(_COLUMNS) - 2)
         stale = row["staleness_mean"]
         ops_s = row["ops_per_s"]
+        p99 = row.get("p99_s")
         return [
             str(row["rank"]), str(row["role"]) or "?",
             str(row["ops_total"]),
             f"{ops_s:.1f}" if ops_s is not None else "-",
+            f"{p99 * 1000.0:.2f}" if p99 is not None else "-",
+            str(row["send_queue"]) if row.get("send_queue") else "-",
             f"{stale:.2f}" if stale is not None else "-",
             str(row["retries"]), str(row["evictions"]),
             str(row["shards"]) if row["shards"] else "-",

@@ -32,6 +32,7 @@ import os
 import sys
 from typing import Dict, List, Optional
 
+from mpit_tpu.obs import clock as _clock
 from mpit_tpu.obs import metrics as _metrics
 from mpit_tpu.obs import spans as _spans
 
@@ -102,8 +103,13 @@ def write_rank_trace(path: str, rank: int, role: str = "",
     obj = {
         "traceEvents": chrome_events(rec, pid=rank, label=label),
         "displayTimeUnit": "ms",
-        "otherData": {"ranks": {str(rank): {"role": role,
-                                            "metrics": reg.snapshot()}}},
+        "otherData": {
+            "ranks": {str(rank): {"role": role, "metrics": reg.snapshot()}},
+            # Per-peer clock-offset estimates (obs/clock.py): the causal
+            # joiner aligns ranks from these instead of re-deriving
+            # offsets from span pairs (obs/causal.py).
+            "clock": _clock.snapshot_all(),
+        },
     }
     with open(path, "w") as fh:
         json.dump(obj, fh)
@@ -128,15 +134,18 @@ def merge_traces(out_path: str, parts: List[str]) -> int:
     own pid) into one merged trace; returns the merged event count."""
     events: List[dict] = []
     ranks: Dict[str, dict] = {}
+    clock: Dict[str, dict] = {}
     for p in parts:
         with open(p) as fh:
             obj = json.load(fh)
         events.extend(obj.get("traceEvents", []))
-        ranks.update((obj.get("otherData") or {}).get("ranks", {}))
+        other = obj.get("otherData") or {}
+        ranks.update(other.get("ranks", {}))
+        clock.update(other.get("clock", {}))
     events.sort(key=lambda e: e.get("ts", -1.0))
     with open(out_path, "w") as fh:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms",
-                   "otherData": {"ranks": ranks}}, fh)
+                   "otherData": {"ranks": ranks, "clock": clock}}, fh)
     return len(events)
 
 

@@ -65,19 +65,25 @@ from mpit_tpu.aio import (
 from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.ft import (
+    ACK_TIMING_WORDS,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
     FLAG_STALENESS,
+    FLAG_TIMING,
     HDR_BYTES,
-    HDR_STALE_BYTES,
     FTConfig,
     RetryExhausted,
     RetryPolicy,
+    hdr_bytes,
     header_frame,
     init_v3,
     pack_header,
+    pack_tx_stamp,
     pack_version,
+    reply_hdr_bytes,
+    timed_frame,
     unpack_header,
+    unpack_reply_stamps,
     unpack_version,
 )
 from mpit_tpu.obs import (
@@ -88,6 +94,7 @@ from mpit_tpu.obs import (
     register_status_provider,
     registry_or_local,
 )
+from mpit_tpu.obs import clock as obs_clock
 from mpit_tpu.ps import tags
 from mpit_tpu.ps.sharding import Shard
 from mpit_tpu.shardctl import shardmap as _shardmap
@@ -145,18 +152,35 @@ class ParamClient:
         # shardctl's shard-addressed header has no version slot yet, so
         # the flag negotiates off there (docs/PROTOCOL.md §6.6).
         self._stale = self.ft.stale_track and not self._sc
+        # Causal-timing telemetry (obs/clock, obs/causal): with
+        # FLAG_TIMING negotiated, data frames carry a wall-µs send stamp
+        # and every ack/reply a [t_tx_echo, t_recv, t_ack] tail — the
+        # four NTP marks that feed the per-server clock-offset estimator
+        # below.  Rides the framed wire like staleness; off under
+        # shardctl (the 32-byte shard header has no stamp slot, §6.7).
+        self._timing = self.ft.timing_track and not self._sc
         #: per-server param version this client last read (the basis the
         #: next gradient is computed against); 0 until the first read.
         self._basis: Dict[int, int] = {}
         # Per-server codec state: encode/decode staging sized to the wire
         # format (plus the FT header when framed), plus the int8
-        # error-feedback residual (grad path only).
-        self._hdr = ((HDR_STALE_BYTES if self._stale else HDR_BYTES)
+        # error-feedback residual (grad path only).  Data frames and
+        # PARAM replies size their headers independently — the timing
+        # tail makes a reply header wider than a data-frame header.
+        self._hdr = (hdr_bytes(self._stale, self._timing)
                      if self.ft.framed else 0)
+        self._hdr_rx = (reply_hdr_bytes(self._stale, self._timing)
+                        if self.ft.framed else 0)
         self._grad_wire: Dict[int, np.ndarray] = {}
         self._param_wire: Dict[int, np.ndarray] = {}
+        self._param_rx: Dict[int, np.ndarray] = {}
         self._residual: Dict[int, np.ndarray] = {}
         self._ack_buf: Dict[int, np.ndarray] = {}
+        #: per-server clock-offset estimator (fed by FLAG_TIMING tails;
+        #: registered so trace exports / flight dumps embed the state).
+        self._clock = obs_clock.ClockEstimator()
+        obs_clock.register(f"client{rank}", self._clock)
+        self._m_clock: Dict[int, object] = {}
         #: per-(server, tag) op sequence numbers (FT framing identity)
         self._seq: Dict[Tuple[int, int], int] = {}
         self._hb_last = 0.0
@@ -228,22 +252,30 @@ class ParamClient:
         self.shards = [e.shard for e in self.smap.entries]
         flags = (FLAG_FRAMED if self.ft.framed else 0) | (
             FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0
-        ) | (FLAG_STALENESS if self._stale else 0)
+        ) | (FLAG_STALENESS if self._stale else 0) | (
+            FLAG_TIMING if self._timing else 0)
         for srank, shard in zip(self.sranks, self.shards):
+            body = (self.codec.wire_nbytes(shard.size)
+                    if not self.codec.identity
+                    else shard.size * param.dtype.itemsize)
             if not self.codec.identity:
-                nbytes = self._hdr + self.codec.wire_nbytes(shard.size)
-                self._grad_wire[srank] = np.zeros(nbytes, np.uint8)
-                self._param_wire[srank] = np.zeros(nbytes, np.uint8)
+                self._grad_wire[srank] = np.zeros(self._hdr + body, np.uint8)
+                self._param_wire[srank] = np.zeros(self._hdr + body, np.uint8)
                 if self.codec.uses_residual:
                     self._residual[srank] = np.zeros(shard.size, np.float32)
             elif self._hdr:
                 # Identity codec under FT framing: raw dtype bytes behind
                 # the header (the one staging copy framing costs).
-                nbytes = self._hdr + shard.size * param.dtype.itemsize
-                self._grad_wire[srank] = np.zeros(nbytes, np.uint8)
-                self._param_wire[srank] = np.zeros(nbytes, np.uint8)
+                self._grad_wire[srank] = np.zeros(self._hdr + body, np.uint8)
+                self._param_wire[srank] = np.zeros(self._hdr + body, np.uint8)
             if self._hdr:
-                self._ack_buf[srank] = np.zeros(2, np.int64)
+                # PARAM replies carry the (possibly wider) reply header —
+                # the timing tail rides there — so reads stage separately
+                # from the identically-bodied push frames.
+                self._param_rx[srank] = np.zeros(self._hdr_rx + body,
+                                                 np.uint8)
+                self._ack_buf[srank] = np.zeros(
+                    ACK_TIMING_WORDS if self._timing else 2, np.int64)
             if self.ft.active:
                 cinfo = init_v3(shard.offset, shard.size,
                                 self.codec.wire_id, self.ft.epoch, flags)
@@ -372,10 +404,16 @@ class ParamClient:
             deadline = self._op_deadline()
             try:
                 span.mark("send")
+                if self._timing:
+                    # Re-stamped per attempt; the server echoes whichever
+                    # stamp rode the frame it saw, so the NTP pairing is
+                    # exact even when acks and resends cross.
+                    pack_tx_stamp(payload, self._hdr, obs_clock.wall_us())
                 yield from aio_send(self.transport, payload, srank, tag,
                                     live=self.live, deadline=deadline)
                 span.mark("ack")
-                got = yield from self._await_ack(srank, ack_tag, seq, deadline)
+                got = yield from self._await_ack(srank, ack_tag, seq,
+                                                 deadline, span=span)
                 if got is not None or not self.live.io:
                     span.end("ok" if got is not None else "aborted")
                     return got
@@ -386,13 +424,30 @@ class ParamClient:
                           attempts=self._retry.attempts, peer=srank)
         raise RetryExhausted(what, self._retry.attempts, last)
 
+    def _feed_clock(self, srank: int, t_tx: int, t_recv: int,
+                    t_ack: int) -> None:
+        """One FLAG_TIMING exchange into the per-server estimator
+        (t4 = now on this client's time base); accepted samples surface
+        on the mpit_clock_offset_us gauge."""
+        if self._clock.add_exchange(srank, t_tx, t_recv, t_ack,
+                                    obs_clock.wall_us()):
+            gauge = self._m_clock.get(srank)
+            if gauge is None:
+                gauge = self.metrics.gauge("mpit_clock_offset_us",
+                                           rank=self.rank, peer=srank)
+                self._m_clock[srank] = gauge
+            gauge.set(self._clock.peer(srank).offset_us)
+
     def _await_ack(self, srank: int, ack_tag: int, seq: int,
-                   deadline: Optional[float]):
+                   deadline: Optional[float], span=NULL_SPAN):
         """Receive acks until the one echoing ``seq`` for the current
         epoch arrives.  Stale echoes (an earlier attempt's duplicate, a
         previous incarnation's leftovers) are consumed and dropped — on
         the attempt's unchanged deadline, so a trickle of stale acks
-        cannot extend it."""
+        cannot extend it.  Under FLAG_TIMING every current-epoch ack —
+        matched or stale — is a complete NTP exchange and feeds the
+        clock estimator; the matched one also lands its server stamps
+        on the op span, so the trace carries both halves' marks."""
         buf = self._ack_buf[srank]
         while True:
             got = yield from aio_recv(self.transport, srank, ack_tag,
@@ -401,7 +456,13 @@ class ParamClient:
             if got is None:
                 return None
             epoch, aseq = int(buf[0]), int(buf[1])
+            if self._timing and epoch == self.ft.epoch:
+                self._feed_clock(srank, int(buf[2]), int(buf[3]),
+                                 int(buf[4]))
             if epoch == self.ft.epoch and aseq == seq:
+                if self._timing:
+                    span.note(tx_us=int(buf[2]), srv_recv_us=int(buf[3]),
+                              srv_ack_us=int(buf[4]))
                 return got
             if epoch > self.ft.epoch or (epoch == self.ft.epoch and aseq > seq):
                 raise RuntimeError(
@@ -424,7 +485,14 @@ class ParamClient:
             return
         self._hb_last = now
         self._hb_seq += 1
-        payload = header_frame(self.ft.epoch, self._hb_seq)
+        # Timing pairs stamp the beat: the server echoes the stamp back
+        # with its own receive/send marks (HEARTBEAT_ECHO), so the clock
+        # estimator refreshes from the heartbeat stream even when no op
+        # is in flight.
+        payload = (timed_frame(self.ft.epoch, self._hb_seq,
+                               obs_clock.wall_us())
+                   if self._timing
+                   else header_frame(self.ft.epoch, self._hb_seq))
         self._m_hb.inc()
         for srank in self.sranks:
             self.sched.spawn(
@@ -439,6 +507,26 @@ class ParamClient:
             )
         except DeadlineExceeded:
             pass  # liveness is best-effort; the next beat tries again
+
+    def _drain_clock_echoes(self) -> None:
+        """Consume pending HEARTBEAT_ECHO replies (probed, never
+        blocking — the _sc_poll_map pattern): each carries a complete
+        [t_tx_echo, t_recv, t_ack] exchange, refreshing the per-server
+        clock offset while the trainer is compute-bound between ops.  A
+        lost or late echo costs nothing — the next beat makes another."""
+        if not self._timing or not self._started:
+            return
+        for srank in self.sranks:
+            while self.transport.iprobe(srank, tags.HEARTBEAT_ECHO):
+                handle = self.transport.irecv(srank, tags.HEARTBEAT_ECHO)
+                while not self.transport.test(handle):
+                    pass  # iprobe saw a fully-assembled message
+                tail = np.frombuffer(
+                    bytes(self.transport.payload(handle)), np.int64)
+                if (len(tail) >= ACK_TIMING_WORDS
+                        and int(tail[0]) == self.ft.epoch):
+                    self._feed_clock(srank, int(tail[2]), int(tail[3]),
+                                     int(tail[4]))
 
     # -- shardctl: shard-addressed ops over the versioned map ----------------
 
@@ -530,7 +618,8 @@ class ParamClient:
         shard's staging frame, then run the attempt loop.  The residual
         folds at this single encode; re-routes resend the same bytes."""
         shard = self.smap.entry(sid).shard
-        span = self._spans.op(what, peer=sid, side="client")
+        span = self._spans.op(what, peer=sid, side="client",
+                              rank=self.rank)
         view = (self.grad if tag == tags.GRAD else
                 self.param)[shard.offset: shard.end]
         wire = self._sc_wire[sid]
@@ -552,7 +641,8 @@ class ParamClient:
         """One shard read: request-by-header, decode the OK reply's
         snapshot frame into the param slice."""
         shard = self.smap.entry(sid).shard
-        span = self._spans.op("PARAM", peer=sid, side="client")
+        span = self._spans.op("PARAM", peer=sid, side="client",
+                              rank=self.rank)
         out = self.param[shard.offset: shard.end]
         seq = self._sc_next_seq(sid, tags.PARAM_REQ)
         span.note(epoch=self.ft.epoch, seq=seq, shard=sid)
@@ -707,7 +797,8 @@ class ParamClient:
         the per-server staging frame at ship time; the int8 residual is
         folded in and refreshed by the same pass.  Framed mode stamps
         [epoch, seq] and retries the staged bytes on deadline."""
-        span = self._spans.op("GRAD", peer=srank, side="client")
+        span = self._spans.op("GRAD", peer=srank, side="client",
+                              rank=self.rank)
         view = self.grad[shard.offset : shard.end]
         wire = self._grad_wire.get(srank)
         span.mark("encode")
@@ -741,7 +832,8 @@ class ParamClient:
         (reference pclient.lua:72-82) — via the wire staging frame when
         the codec is not identity.  Framed mode seq-tags the request and
         discards snapshot frames that echo an earlier request."""
-        span = self._spans.op("PARAM", peer=srank, side="client")
+        span = self._spans.op("PARAM", peer=srank, side="client",
+                              rank=self.rank)
         out = self.param[shard.offset : shard.end]
         wire = self._param_wire.get(srank)
         if not self.ft.framed:
@@ -762,7 +854,9 @@ class ParamClient:
             return
         seq = self._next_seq(srank, tags.PARAM_REQ)
         span.note(epoch=self.ft.epoch, seq=seq)
-        req = header_frame(self.ft.epoch, seq)
+        wire = self._param_rx[srank]
+        req = (timed_frame(self.ft.epoch, seq, 0) if self._timing
+               else header_frame(self.ft.epoch, seq))
         last: Optional[BaseException] = None
         for attempt in range(self._retry.attempts):
             if attempt:
@@ -777,6 +871,8 @@ class ParamClient:
             deadline = self._op_deadline()
             try:
                 span.mark("send")
+                if self._timing:
+                    req[2] = obs_clock.wall_us()  # re-stamped per attempt
                 yield from aio_send(self.transport, req, srank,
                                     tags.PARAM_REQ, live=self.live,
                                     deadline=deadline)
@@ -790,11 +886,20 @@ class ParamClient:
                         span.end("aborted")
                         return
                     epoch, aseq = unpack_header(wire)
+                    if self._timing and epoch == self.ft.epoch:
+                        # Any current-epoch reply — matched or a stale
+                        # duplicate — is a complete NTP exchange.
+                        t_tx, t_recv, t_ack = unpack_reply_stamps(
+                            wire, self._hdr_rx - 24)
+                        self._feed_clock(srank, t_tx, t_recv, t_ack)
                     if epoch == self.ft.epoch and aseq == seq:
                         if self._stale:
                             # The reply's version word is the basis the
                             # next gradient to this server will echo.
                             self._basis[srank] = unpack_version(wire)
+                        if self._timing:
+                            span.note(tx_us=t_tx, srv_recv_us=t_recv,
+                                      srv_ack_us=t_ack)
                         span.mark("decode")
                         self._decode_framed(wire, out)
                         span.end("ok")
@@ -813,7 +918,8 @@ class ParamClient:
         """Whole-shard write, await ack (reference pclient.lua:60-70).
         No residual: parameter pushes (seeding / single-worker mirror)
         are one-shot state transfers, not an accumulating signal."""
-        span = self._spans.op("PARAM_PUSH", peer=srank, side="client")
+        span = self._spans.op("PARAM_PUSH", peer=srank, side="client",
+                              rank=self.rank)
         view = self.param[shard.offset : shard.end]
         wire = self._param_wire.get(srank)
         span.mark("encode")
@@ -858,7 +964,7 @@ class ParamClient:
         return wire
 
     def _decode_framed(self, wire: np.ndarray, out: np.ndarray) -> None:
-        body = wire[self._hdr :]
+        body = wire[self._hdr_rx :]
         if self.codec.identity:
             out.view(np.uint8)[:] = body
         else:
@@ -934,6 +1040,7 @@ class ParamClient:
         (reference pclient.lua:131-136)."""
         self._maybe_heartbeat()
         self._sc_poll_map()
+        self._drain_clock_echoes()
         for _ in range(n):
             self.sched.ping()
 
@@ -945,6 +1052,7 @@ class ParamClient:
             while self.sched.queue:
                 self._maybe_heartbeat()
                 self._sc_poll_map()
+                self._drain_clock_echoes()
                 self.sched.ping_pass()
             if self.sched.errors:
                 raise self.sched.errors.pop(0)

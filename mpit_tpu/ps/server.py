@@ -79,18 +79,24 @@ from mpit_tpu.aio import (
 from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.ft import (
+    ACK_TIMING_WORDS,
     DUP,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
     FLAG_STALENESS,
+    FLAG_TIMING,
     HDR_BYTES,
-    HDR_STALE_BYTES,
     STALE,
+    TIMING_TAIL_BYTES,
     DedupTable,
     FTConfig,
     LeaseRegistry,
+    hdr_bytes,
+    pack_reply_stamps,
     pack_version,
+    reply_hdr_bytes,
     unpack_header,
+    unpack_tx_stamp,
     unpack_version,
 )
 from mpit_tpu.obs import (
@@ -100,6 +106,7 @@ from mpit_tpu.obs import (
     register_status_provider,
     registry_or_local,
 )
+from mpit_tpu.obs import clock as obs_clock
 from mpit_tpu.optim.rules import ShardRule, make as make_rule
 from mpit_tpu.ps import tags
 from mpit_tpu.shardctl import migrate as _scmigrate
@@ -174,6 +181,12 @@ class ParamServer:
         # feeds the mpit_ps_grad_staleness histogram.
         self._stale_track: Dict[int, bool] = {}
         self._stale_hists: Dict[int, Any] = {}
+        # Causal-timing posture (FLAG_TIMING, §6.7): frames from these
+        # clients carry a trailing send stamp; their acks/replies grow
+        # the [t_tx_echo, t_recv, t_ack] tail the client's clock-offset
+        # estimator consumes, and their heartbeats are echoed back on
+        # HEARTBEAT_ECHO so the estimate refreshes between ops.
+        self._timing: Dict[int, bool] = {}
         self._gen: Dict[int, int] = {c: 0 for c in self.cranks}
         self._svc_live: Dict[int, int] = {c: 0 for c in self.cranks}
         self._param_send: Dict[int, np.ndarray] = {}
@@ -290,6 +303,7 @@ class ParamServer:
                     "epoch": self.leases.epoch(c),
                     "framed": self._framed.get(c, False),
                     "stale": self._stale_track.get(c, False),
+                    "timing": self._timing.get(c, False),
                     "codec": getattr(self._codecs.get(c), "name", None),
                 }
                 for c in self.cranks
@@ -419,6 +433,9 @@ class ParamServer:
         # without FLAG_FRAMED negotiates off (nothing to extend).
         self._stale_track[crank] = (self._framed[crank]
                                     and bool(flags & FLAG_STALENESS))
+        # Same rule for the timing extension: no frame, no stamp slot.
+        self._timing[crank] = (self._framed[crank]
+                               and bool(flags & FLAG_TIMING))
         self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
         return codec
 
@@ -460,8 +477,10 @@ class ParamServer:
         self._framed[crank] = True
         self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
         # The 32-byte shard-addressed header has no version slot; the
-        # staleness extension negotiates off under shardctl (§6.6).
+        # staleness and timing extensions negotiate off under shardctl
+        # (§6.6, §6.7).
         self._stale_track[crank] = False
+        self._timing[crank] = False
         self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
         return codec
 
@@ -480,9 +499,19 @@ class ParamServer:
         return slot
 
     def _hdr_for(self, crank: int) -> int:
+        """Header size of this client's data frames (GRAD/PARAM_PUSH)."""
         if not self._framed.get(crank):
             return 0
-        return HDR_STALE_BYTES if self._stale_track.get(crank) else HDR_BYTES
+        return hdr_bytes(self._stale_track.get(crank, False),
+                         self._timing.get(crank, False))
+
+    def _reply_hdr_for(self, crank: int) -> int:
+        """Header size of PARAM replies to this client (the timing tail
+        makes replies wider than data frames)."""
+        if not self._framed.get(crank):
+            return 0
+        return reply_hdr_bytes(self._stale_track.get(crank, False),
+                               self._timing.get(crank, False))
 
     def _stale_hist(self, crank: int):
         """The per-client staleness histogram, cached (one get-or-create
@@ -523,11 +552,13 @@ class ParamServer:
             buf = np.zeros(hdr + codec.wire_nbytes(self.size), np.uint8)
             self.grad_bufs[crank] = buf
             self._grad_views[crank] = codec.split_wire(buf[hdr:], self.size)
+        timing = self._timing.get(crank, False)
         if hdr:
-            self._ack_send[crank] = np.zeros(2, np.int64)
-            self._req_buf[crank] = np.zeros(2, np.int64)
+            self._ack_send[crank] = np.zeros(
+                ACK_TIMING_WORDS if timing else 2, np.int64)
+            self._req_buf[crank] = np.zeros(3 if timing else 2, np.int64)
         if self._hb.get(crank):
-            self._hb_buf[crank] = np.zeros(2, np.int64)
+            self._hb_buf[crank] = np.zeros(3 if timing else 2, np.int64)
 
     def _release_client(self, crank: int) -> None:
         """Drop an evicted client's staging (its shard registration's
@@ -642,9 +673,15 @@ class ParamServer:
         finally:
             self._svc_live[crank] -= 1
 
-    def _send_ack(self, crank: int, tag: int, epoch: int, seq: int, gen: int):
+    def _send_ack(self, crank: int, tag: int, epoch: int, seq: int, gen: int,
+                  t_tx: int = 0, t_recv: int = 0):
         buf = self._ack_send[crank]
         buf[0], buf[1] = epoch, seq
+        if self._timing.get(crank):
+            # FLAG_TIMING tail: the echoed client send stamp, this
+            # frame's receive stamp, and the ack-send stamp taken now —
+            # one complete NTP exchange per ack (§6.7).
+            buf[2], buf[3], buf[4] = t_tx, t_recv, obs_clock.wall_us()
         yield from aio_send(self.transport, buf, crank, tag, live=self.live,
                             abort=self._svc_abort(crank, gen))
 
@@ -701,6 +738,7 @@ class ParamServer:
         if codec is None:  # init never completed (stopped before announce)
             return
         framed = self._framed.get(crank, False)
+        timing = self._timing.get(crank, False)
         hdr = self._hdr_for(crank)
         staging = self._push_staging(crank)
         while self.live.on:
@@ -710,8 +748,12 @@ class ParamServer:
             )
             if got is None:
                 return
-            epoch = seq = 0
-            span = self._spans.op("PARAM_PUSH", peer=crank, side="server")
+            epoch = seq = t_tx = t_recv = 0
+            if timing:
+                t_recv = obs_clock.wall_us()
+                t_tx = unpack_tx_stamp(staging, hdr)
+            span = self._spans.op("PARAM_PUSH", peer=crank, side="server",
+                                  rank=self.rank)
             if framed:
                 epoch, seq = unpack_header(staging)
                 span.note(epoch=epoch, seq=seq)
@@ -725,7 +767,8 @@ class ParamServer:
                     self._m_dups.inc()
                     span.mark("ack")
                     yield from self._send_ack(
-                        crank, tags.PARAM_PUSH_ACK, epoch, seq, gen)
+                        crank, tags.PARAM_PUSH_ACK, epoch, seq, gen,
+                        t_tx=t_tx, t_recv=t_recv)
                     span.end("dup")
                     continue
             if warn_unexpected:
@@ -748,7 +791,8 @@ class ParamServer:
             span.mark("ack")
             if framed:
                 yield from self._send_ack(
-                    crank, tags.PARAM_PUSH_ACK, epoch, seq, gen)
+                    crank, tags.PARAM_PUSH_ACK, epoch, seq, gen,
+                    t_tx=t_tx, t_recv=t_recv)
             else:
                 yield from aio_send(
                     self.transport, tags.EMPTY, crank, tags.PARAM_PUSH_ACK,
@@ -768,6 +812,7 @@ class ParamServer:
         if codec is None:  # init never completed (stopped before announce)
             return
         framed = self._framed.get(crank, False)
+        timing = self._timing.get(crank, False)
         while self.live.on:
             req = self._req_buf.get(crank) if framed else None
             got = yield from aio_recv(
@@ -778,7 +823,9 @@ class ParamServer:
                 return
             if not self.live.io:
                 continue
-            span = self._spans.op("PARAM", peer=crank, side="server")
+            t_recv = obs_clock.wall_us() if timing else 0
+            span = self._spans.op("PARAM", peer=crank, side="server",
+                                  rank=self.rank)
             if not framed:
                 span.mark("snapshot")
                 snapshot = self._snapshot_wire(codec)
@@ -798,7 +845,7 @@ class ParamServer:
                 continue
             self.leases.renew(crank, epoch)
             span.mark("snapshot")
-            hdr = self._hdr_for(crank)
+            hdr = self._reply_hdr_for(crank)
             wire = self._snapshot_wire(codec)
             wire_u8 = wire.view(np.uint8) if wire.dtype != np.uint8 else wire
             reply = self._param_send.get(crank)
@@ -812,6 +859,11 @@ class ParamServer:
                 pack_version(reply, self._snap_version)
             reply[hdr:] = wire_u8
             span.mark("send")
+            if timing:
+                # The reply's timing tail (§6.7): echoed request stamp,
+                # the request's receive stamp, and the send stamp now.
+                pack_reply_stamps(reply, hdr - TIMING_TAIL_BYTES,
+                                  int(req[2]), t_recv, obs_clock.wall_us())
             yield from aio_send(
                 self.transport, reply, crank, tags.PARAM, live=self.live,
                 abort=self._svc_abort(crank, gen),
@@ -829,6 +881,8 @@ class ParamServer:
         if codec is None:  # init never completed (stopped before announce)
             return
         framed = self._framed.get(crank, False)
+        timing = self._timing.get(crank, False)
+        hdr = self._hdr_for(crank)
         gbuf = self.grad_bufs[crank]
         parts = self._grad_views.get(crank)
         data = self._grad_data.get(crank)
@@ -840,8 +894,12 @@ class ParamServer:
             )
             if got is None:
                 return
-            epoch = seq = 0
-            span = self._spans.op("GRAD", peer=crank, side="server")
+            epoch = seq = t_tx = t_recv = 0
+            if timing:
+                t_recv = obs_clock.wall_us()
+                t_tx = unpack_tx_stamp(gbuf, hdr)
+            span = self._spans.op("GRAD", peer=crank, side="server",
+                                  rank=self.rank)
             if framed:
                 epoch, seq = unpack_header(gbuf)
                 span.note(epoch=epoch, seq=seq)
@@ -855,7 +913,8 @@ class ParamServer:
                     self._m_dups.inc()
                     span.mark("ack")
                     yield from self._send_ack(crank, tags.GRAD_ACK,
-                                              epoch, seq, gen)
+                                              epoch, seq, gen,
+                                              t_tx=t_tx, t_recv=t_recv)
                     span.end("dup")
                     continue
                 if self._stale_track.get(crank):
@@ -884,7 +943,8 @@ class ParamServer:
                 continue
             span.mark("ack")
             if framed:
-                yield from self._send_ack(crank, tags.GRAD_ACK, epoch, seq, gen)
+                yield from self._send_ack(crank, tags.GRAD_ACK, epoch, seq,
+                                          gen, t_tx=t_tx, t_recv=t_recv)
             else:
                 yield from aio_send(
                     self.transport, tags.EMPTY, crank, tags.GRAD_ACK,
@@ -938,7 +998,8 @@ class ParamServer:
                 return
             buf = np.frombuffer(raw, np.uint8)
             epoch, seq, _mapver, sid = _scwire.unpack_sc_header(buf)
-            span = self._spans.op("GRAD", peer=crank, side="server")
+            span = self._spans.op("GRAD", peer=crank, side="server",
+                                  rank=self.rank)
             span.note(epoch=epoch, seq=seq, shard=sid)
             self.leases.renew(crank, epoch)
             verdict = self._sc_verdict(sid)
@@ -1017,7 +1078,8 @@ class ParamServer:
             if not self.live.io:
                 continue
             epoch, seq, _mapver, sid = (int(x) for x in req)
-            span = self._spans.op("PARAM", peer=crank, side="server")
+            span = self._spans.op("PARAM", peer=crank, side="server",
+                                  rank=self.rank)
             span.note(epoch=epoch, seq=seq, shard=sid)
             if epoch < self.leases.epoch(crank):
                 self._m_stale.inc()  # dead incarnation's request
@@ -1069,7 +1131,8 @@ class ParamServer:
                 return
             buf = np.frombuffer(raw, np.uint8)
             epoch, seq, _mapver, sid = _scwire.unpack_sc_header(buf)
-            span = self._spans.op("PARAM_PUSH", peer=crank, side="server")
+            span = self._spans.op("PARAM_PUSH", peer=crank, side="server",
+                                  rank=self.rank)
             span.note(epoch=epoch, seq=seq, shard=sid)
             self.leases.renew(crank, epoch)
             verdict = self._sc_verdict(sid)
@@ -1147,7 +1210,8 @@ class ParamServer:
         """Source side of a live migration: flip to the new map first
         (every later op for the shard drains via NACK_MAP), freeze the
         slot, serve exactly one SHARD_PULL, ship the state, drop it."""
-        span = self._spans.op("MIGRATE", peer=dst, side="server")
+        span = self._spans.op("MIGRATE", peer=dst, side="server",
+                              rank=self.rank)
         span.note(shard=sid, direction="out")
         slot = self._slots.get(sid)
         if slot is None:
@@ -1183,7 +1247,8 @@ class ParamServer:
     def _sc_acquire(self, sid: int, src: int, new_map: ShardMap):
         """Destination side: adopt the map, pull the frozen state, place
         it on this server's backend, echo DONE to the controller."""
-        span = self._spans.op("MIGRATE", peer=src, side="server")
+        span = self._spans.op("MIGRATE", peer=src, side="server",
+                              rank=self.rank)
         span.note(shard=sid, direction="in")
         self._sc_install_map(new_map)
         deadline = deadline_at(_scmigrate.SC_DEADLINE_S)
@@ -1223,7 +1288,8 @@ class ParamServer:
         the dead server applied-and-checkpointed dedup as DUP; ops after
         its last checkpoint are still unacked client-side and re-apply
         exactly once (the checkpoint is the consistency cut, §6.3)."""
-        span = self._spans.op("MIGRATE", peer=dead, side="server")
+        span = self._spans.op("MIGRATE", peer=dead, side="server",
+                              rank=self.rank)
         span.note(shard=sid, direction="adopt")
         self._sc_install_map(new_map)
         if not self._ckpt_dir:
@@ -1283,10 +1349,15 @@ class ParamServer:
     def _recv_heartbeat(self, crank: int, gen: int = 0):
         """Loop: consume HEARTBEAT beacons, renew the client's lease
         (current-epoch beats only — a dead incarnation's leftovers must
-        not keep its successor's lease alive)."""
+        not keep its successor's lease alive).  Timing pairs get each
+        beat echoed back (HEARTBEAT_ECHO with the §6.7 tail), so the
+        client's clock-offset estimator refreshes from the heartbeat
+        stream even while no op is in flight."""
         buf = self._hb_buf.get(crank)
         if buf is None:
             return
+        timing = self._timing.get(crank, False)
+        echo = np.zeros(ACK_TIMING_WORDS, np.int64) if timing else None
         while self.live.on:
             got = yield from aio_recv(
                 self.transport, crank, tags.HEARTBEAT, live=self.live,
@@ -1294,8 +1365,17 @@ class ParamServer:
             )
             if got is None:
                 return
+            t_recv = obs_clock.wall_us() if timing else 0
             self._m_hb_seen.inc()
             self.leases.renew(crank, int(buf[0]))
+            if timing:
+                echo[0], echo[1] = buf[0], buf[1]
+                echo[2], echo[3] = buf[2], t_recv
+                echo[4] = obs_clock.wall_us()
+                yield from aio_send(
+                    self.transport, echo, crank, tags.HEARTBEAT_ECHO,
+                    live=self.live, abort=self._svc_abort(crank, gen),
+                )
 
     def _recv_stop(self, crank: int, gen: int = 0):
         """Await the stop signal; all clients terminal (stopped or
@@ -1355,6 +1435,7 @@ class ParamServer:
                 "framed": self._framed.get(c, False),
                 "hb": self._hb.get(c, False),
                 "stale": self._stale_track.get(c, False),
+                "timing": self._timing.get(c, False),
                 "epoch": self.leases.epoch(c),
             }
             for c in self._codecs
@@ -1433,6 +1514,7 @@ class ParamServer:
             self._framed[crank] = bool(info.get("framed", False))
             self._hb[crank] = bool(info.get("hb", False))
             self._stale_track[crank] = bool(info.get("stale", False))
+            self._timing[crank] = bool(info.get("timing", False))
             self.leases.arm(crank, int(info.get("epoch", 0)),
                             heartbeats=self._hb[crank])
             self._alloc_client(crank, codec_mod.get(info.get("codec", "none")))

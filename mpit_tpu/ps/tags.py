@@ -42,6 +42,13 @@ SHARD_PULL = 11  # server(dst) -> server(src): int64 [shard_id] — "I was
 SHARD_STATE = 12  # server(src) -> server(dst): the frozen shard's full
 #                   state (meta json + param bytes + rule-state arrays),
 #                   a multi-message sequence on this one FIFO channel
+HEARTBEAT_ECHO = 13  # server -> client: int64 [epoch, seq, t_tx_echo,
+#                      t_recv, t_ack] — the FLAG_TIMING reply to a timed
+#                      HEARTBEAT beacon (docs/PROTOCOL.md §6.7).  NOT an
+#                      ack tail: heartbeats stay fire-and-forget, and the
+#                      client drains echoes opportunistically (iprobe in
+#                      ping/wait) to refresh its clock-offset estimator
+#                      while compute-bound; a lost echo costs nothing.
 
 EMPTY = b""  # the canonical 0-byte payload
 
@@ -64,4 +71,5 @@ TAG_PAIRS = {
     "MAP_UPDATE": ("controller|server", "server|client|controller"),
     "SHARD_PULL": ("server", "server"),
     "SHARD_STATE": ("server", "server"),
+    "HEARTBEAT_ECHO": ("server", "client"),
 }

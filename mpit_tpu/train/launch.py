@@ -81,6 +81,12 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     # applied grad (mpit_ps_grad_staleness).  Needs ft_op_deadline_s > 0
     # (rides the framed wire); silently off otherwise.
     ft_staleness=False,
+    # Causal-timing telemetry (obs/clock, obs/causal; PROTOCOL.md §6.7):
+    # frames carry a send stamp, acks/replies a [t_tx, t_recv, t_ack]
+    # tail, and heartbeats are echoed — feeding the per-peer clock
+    # offset estimator so `python -m mpit_tpu.obs analyze` can join and
+    # decompose the gang's trace.  Needs ft_op_deadline_s > 0.
+    ft_timing=False,
     supervise=0,
     # shardctl (mpit_tpu.shardctl): the LAST rank becomes the shard-map
     # controller (the rest split into servers/clients as usual), clients
@@ -117,6 +123,8 @@ def ft_from_cfg(cfg: Config):
         overrides["rejoin"] = True
     if bool(cfg.get("ft_staleness", False)):
         overrides["staleness"] = True
+    if bool(cfg.get("ft_timing", False)):
+        overrides["timing"] = True
     return FTConfig.from_env(**overrides)
 
 

@@ -44,3 +44,11 @@ def report_widgets(registry):
     # mpit_good_widgets_total must stay silent.
     registry.counter("mpit_good_widgets_total").inc()
     registry.counter("mpit_rogue_widgets_total").inc()
+
+
+def trace_phases(span):
+    # MT-O404 seed: rogue_phase is absent from this fixture's
+    # docs/OBSERVABILITY.md phase taxonomy; good_phase is documented
+    # there and must stay silent.
+    span.mark("good_phase")
+    span.mark("rogue_phase")

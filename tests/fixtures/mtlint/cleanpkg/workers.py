@@ -36,3 +36,9 @@ apply_update = jax.jit(update, donate_argnums=(0,))
 def report(registry):
     # Cataloged metric (docs/OBSERVABILITY.md names it): MT-O403 silent.
     registry.counter("mpit_clean_jobs_total").inc()
+
+
+def trace_clean_phase(span):
+    # Cataloged span phase (docs/OBSERVABILITY.md names it): MT-O404
+    # stays silent.
+    span.mark("clean_phase")

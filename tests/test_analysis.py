@@ -135,6 +135,15 @@ class TestSeededViolations:
         assert "mpit_rogue_widgets_total" in hits[0].message
         assert "OBSERVABILITY.md" in hits[0].message
 
+    def test_undocumented_phase_detected(self, bad):
+        # MT-O404: rogue_phase is marked but absent from the fixture's
+        # docs/OBSERVABILITY.md phase taxonomy; the documented
+        # good_phase on the line above stays silent.
+        hits = bad.get("MT-O404", [])
+        assert [(f.path, f.line) for f in hits] == [("server.py", 54)]
+        assert "rogue_phase" in hits[0].message
+        assert "OBSERVABILITY.md" in hits[0].message
+
     def test_nonbinary_pairs_exempt_from_role_model(self, bad):
         # The pairing table is what exempts controller / server<->server
         # tags from MT-P101/P102 — the badpkg table is all-binary, so
