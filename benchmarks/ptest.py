@@ -108,6 +108,26 @@ DECOMP_DEADLINE = float(os.environ.get("MPIT_BENCH_DECOMP_DEADLINE", "120"))
 # multi-MB shard transfer, measured off==on within noise).
 SKEW_POLLS = int(os.environ.get("MPIT_BENCH_SKEW_POLLS", "600"))
 SKEW_DEADLINE = float(os.environ.get("MPIT_BENCH_SKEW_DEADLINE", "30"))
+# MPIT_BENCH_READERS="2,64,512": the many-client serving sweep (ISSUE 8,
+# ROADMAP item 1).  Per count N, a TCP gang — MPIT_BENCH_SERVERS servers
+# + 1 writer + N READ-ONLY readers (mpit_tpu.ps.serve) spread over a few
+# reader-host processes — runs paced whole-vector reads against the
+# epoll event-loop transport: every reader pulls the current params
+# MPIT_BENCH_READER_ROUNDS times, one read per
+# MPIT_BENCH_READER_INTERVAL_S (start-staggered), while the writer bumps
+# the param version once per interval.  The row records pooled
+# per-client PARAM p50/p99 latency, aggregate MB/s, BUSY admission
+# counts, and the snapshot-cache counters — the acceptance bar is p50
+# flat within 2x from 64 -> 512 readers while snapshot_copies stays at
+# one per committed version (the N-readers=1-copy invariant at
+# hundreds of connections).  Separate knobs from the shm legs: the
+# serving sweep measures read-latency-under-fanout, not bulk bandwidth.
+READERS_SWEEP = [int(x) for x in
+                 os.environ.get("MPIT_BENCH_READERS", "").split(",") if x]
+READER_MB = float(os.environ.get("MPIT_BENCH_READER_MB", "0.25"))
+READER_ROUNDS = int(os.environ.get("MPIT_BENCH_READER_ROUNDS", "6"))
+READER_INTERVAL = float(os.environ.get("MPIT_BENCH_READER_INTERVAL_S", "1.0"))
+READER_BUDGET_MB = float(os.environ.get("MPIT_BENCH_READER_BUDGET_MB", "8"))
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
 # (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
@@ -541,6 +561,258 @@ def _gang_child() -> None:
         json.dump(result, fh)
 
 
+def bench_readers(nreaders: int) -> dict:
+    """One serving-tier leg: servers + 1 writer + ``nreaders`` paced
+    readers over the TCP event-loop transport, one OS process per
+    server/writer and a few reader-host processes driving many readers
+    each (one transport + one ReaderClient per reader; the *server*
+    side holds all N connections on its single I/O thread)."""
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from mpit_tpu.comm.tcp import allocate_local_addresses
+
+    size = int(READER_MB * (1 << 20) / 4)
+    # One reader-host process by default: on the shared-core bench box,
+    # extra driver processes just contend with the servers (measured:
+    # 4 hosts nearly doubled 512-reader p50 vs 1); the *server* side is
+    # what holds all N connections either way.
+    hosts = max(int(os.environ.get("MPIT_BENCH_READER_HOSTS", "1")), 1)
+    batches = [list(range(NSERVERS + 1 + i, NSERVERS + 1 + nreaders, hosts))
+               for i in range(hosts)]
+    core = NSERVERS + 1
+    nranks = core + nreaders
+    addrs, socks = allocate_local_addresses(core)
+    for s in socks:
+        s.close()  # children rebind these ports
+    addrs = addrs + ["127.0.0.1:0"] * nreaders  # readers never listen
+    _log(f"[serve] {NSERVERS} servers + 1 writer + {nreaders} readers "
+         f"({hosts} host proc(s)), vector {size * 4 / 2**20:.2f} MB, "
+         f"{READER_ROUNDS} reads/reader at {READER_INTERVAL:.2f}s pacing")
+    spec = {
+        "addrs": addrs, "nservers": NSERVERS, "nreaders": nreaders,
+        "size": size, "rounds": READER_ROUNDS, "interval": READER_INTERVAL,
+        "budget_mb": READER_BUDGET_MB,
+    }
+    tmpdir = tempfile.mkdtemp(prefix=f"ptest_serve_{os.getpid()}_")
+    jobs = ([("server", r, None) for r in range(NSERVERS)]
+            + [("writer", NSERVERS, None)]
+            + [("readers", core + i, batch)
+               for i, batch in enumerate(batches) if batch])
+    procs, result_files = [], {}
+    for role, label, batch in jobs:
+        result_path = os.path.join(tmpdir, f"{role}{label}.json")
+        result_files[(role, label)] = result_path
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PTEST_SERVE=json.dumps({**spec, "role": role, "rank": label,
+                                    "batch": batch or []}),
+            PTEST_RESULT=result_path,
+        )
+        log_path = result_path.replace(".json", ".log")
+        with open(log_path, "w") as fh:
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--serve-child"],
+                env=env, stdout=fh, stderr=subprocess.STDOUT, text=True,
+            ))
+    deadline = time.monotonic() + float(
+        os.environ.get("MPIT_BENCH_GANG_TIMEOUT", "900"))
+    try:
+        while any(p.poll() is None for p in procs):
+            bad = next((i for i, p in enumerate(procs)
+                        if p.poll() not in (None, 0)), None)
+            if bad is not None or time.monotonic() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for path in result_files.values():
+                    logp = path.replace(".json", ".log")
+                    if os.path.exists(logp):
+                        with open(logp) as fh:
+                            sys.stderr.write(fh.read())
+                raise RuntimeError(
+                    f"serve gang job {jobs[bad][:2]} failed (logs: {tmpdir})"
+                    if bad is not None else
+                    f"serve gang timed out (logs: {tmpdir})")
+            time.sleep(0.1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    samples, busy_honored, windows, reads = [], 0, [], 0
+    for (role, label), path in result_files.items():
+        with open(path) as fh:
+            rec = json.load(fh)
+        if role == "readers":
+            samples.extend(rec["samples"])
+            busy_honored += rec["busy_honored"]
+            windows.append((rec["t0"], rec["t1"]))
+            reads += rec["reads"]
+    srv = [json.load(open(result_files[("server", r)]))
+           for r in range(NSERVERS)]
+    dt = max(w[1] for w in windows) - min(w[0] for w in windows)
+    arr = np.asarray(samples)
+    p50 = float(np.percentile(arr, 50)) * 1e3
+    p99 = float(np.percentile(arr, 99)) * 1e3
+    mbs = reads * size * 4 / dt / 2**20
+    copies = sum(s["snapshot_copies"] for s in srv)
+    versions = sum(s["snap_version"] for s in srv)
+    if copies > versions + NSERVERS:
+        raise RuntimeError(
+            f"snapshot cache broke under fan-out: {copies} copies for "
+            f"{versions} committed versions (the N-readers=1-copy "
+            "invariant must hold at every reader count)")
+    import shutil
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    _log(f"[serve] {nreaders} readers: p50 {p50:.1f} ms, p99 {p99:.1f} ms, "
+         f"{mbs:.1f} MB/s aggregate, busy={sum(s['busy_replies'] for s in srv)}"
+         f"/{busy_honored} (issued/honored), copies={copies} for "
+         f"{versions} versions")
+    return {
+        "metric": "ps_serve_read_latency",
+        "unit": "ms",
+        "value": round(p50, 2),
+        "p99_ms": round(p99, 2),
+        "readers": nreaders,
+        "reads": reads,
+        "mbs": round(mbs, 1),
+        "vector_mb": round(size * 4 / 2**20, 3),
+        "interval_s": READER_INTERVAL,
+        "busy_replies": sum(s["busy_replies"] for s in srv),
+        "busy_honored": busy_honored,
+        "snapshot_copies": copies,
+        "snap_versions": versions,
+        "snapshot_hits": sum(s["snapshot_hits"] for s in srv),
+    }
+
+
+def _serve_child() -> None:
+    """One process of the serving-tier gang (--serve-child): a server
+    or the writer for its single rank, or a reader host driving a batch
+    of readers (one transport + ReaderClient per reader, all stepped by
+    one thread — the server side is what holds N connections)."""
+    import numpy as np
+
+    from mpit_tpu.comm.tcp import TcpTransport
+    from mpit_tpu.ft import FTConfig
+    from mpit_tpu.ps import ParamClient, ParamServer, ReaderClient, ServeConfig
+
+    spec = json.loads(os.environ["PTEST_SERVE"])
+    addrs = spec["addrs"]
+    nranks = len(addrs)
+    sranks = list(range(spec["nservers"]))
+    wrank = spec["nservers"]
+    readers = list(range(wrank + 1, nranks))
+    size = spec["size"]
+    rounds, interval = spec["rounds"], spec["interval"]
+    role = spec["role"]
+    ft = FTConfig(op_deadline_s=120.0)
+    if role == "server":
+        rank = spec["rank"]
+        transport = TcpTransport(rank, nranks, addrs, reconnect=120.0,
+                                 dial_peers=list(range(rank)),
+                                 connect_timeout=120.0)
+        server = ParamServer(
+            rank, [wrank], transport, rule="add", reader_ranks=readers,
+            serve=ServeConfig(budget_bytes=int(spec["budget_mb"] * (1 << 20))))
+        server.start()
+        result = {
+            "role": "server",
+            "busy_replies": server.busy_replies,
+            "snapshot_copies": server.snapshot_copies,
+            "snapshot_hits": server.snapshot_hits,
+            "snap_version": server._snap_version,
+            "params_served": server.params_served,
+            "grads_applied": server.grads_applied,
+        }
+        transport.close()
+    elif role == "writer":
+        transport = TcpTransport(wrank, nranks, addrs, reconnect=120.0,
+                                 dial_peers=sranks, connect_timeout=120.0)
+        client = ParamClient(wrank, sranks, transport, seed_servers=True,
+                             ft=ft)
+        param = np.arange(size, dtype=np.float32)
+        grad = np.full(size, 1e-6, np.float32)
+        client.start(param, grad)
+        # One committed version per pacing interval for the whole read
+        # window (+1 slack): readers must observe versions moving.
+        for _ in range(rounds + 1):
+            client.async_send_grad()
+            client.wait()
+            time.sleep(interval)
+        client.stop()
+        result = {"role": "writer", "grads": rounds + 1}
+        transport.close()
+    else:  # reader host
+        batch = spec["batch"]
+        transports, clients = {}, {}
+        for r in batch:
+            transports[r] = TcpTransport(r, nranks, addrs, reconnect=120.0,
+                                         dial_peers=sranks, listen=False,
+                                         connect_timeout=120.0)
+            clients[r] = ReaderClient(r, sranks, transports[r], ft=ft)
+            clients[r].start(np.zeros(size, np.float32))
+        for r in batch:  # one warmup read (first-touch, codec caches)
+            clients[r].read_params()
+        # Paced async driver: start-staggered reads, one thread stepping
+        # every in-flight reader round-robin; per-read latency sampled
+        # from async-start to drain.
+        t_start = time.time()
+        base = time.monotonic()
+        state = {r: {"next": base + (i / max(len(batch), 1)) * interval,
+                     "t0": None, "reads": 0}
+                 for i, r in enumerate(batch)}
+        samples = []
+        import heapq
+
+        inflight: set = set()
+        due = [(state[r]["next"], r) for r in batch]
+        heapq.heapify(due)
+        pending = len(batch)
+        while pending or inflight:
+            now = time.monotonic()
+            while due and due[0][0] <= now:  # O(newly due), not O(batch)
+                _t, r = heapq.heappop(due)
+                clients[r].async_read_params()
+                state[r]["t0"] = time.monotonic()
+                inflight.add(r)
+            for r in list(inflight):  # hot path: only in-flight readers
+                if not clients[r].poll():
+                    st = state[r]
+                    samples.append(time.monotonic() - st["t0"])
+                    st["reads"] += 1
+                    st["next"] = st["t0"] + interval
+                    st["t0"] = None
+                    inflight.discard(r)
+                    if st["reads"] >= rounds:
+                        pending -= 1
+                    else:
+                        heapq.heappush(due, (st["next"], r))
+            # Yield the core between passes (a driver spinning poll()
+            # flat-out steals the cycles the colocated 1-core servers
+            # need to produce the replies being waited for — the
+            # IDLE_USEC lesson), but keep the in-flight cadence tight:
+            # a paced read's latency floor is this sleep times the
+            # number of protocol hops.
+            time.sleep(0.0002 if inflight else 0.001)
+        t_end = time.time()
+        for r in batch:
+            assert clients[r].monotone, f"reader {r} saw a version go back"
+            clients[r].stop()
+            transports[r].close()
+        result = {
+            "role": "readers", "samples": samples,
+            "reads": sum(st["reads"] for st in state.values()),
+            "busy_honored": sum(c.busy_honored for c in clients.values()),
+            "t0": t_start, "t1": t_end,
+        }
+    with open(os.environ["PTEST_RESULT"], "w") as fh:
+        json.dump(result, fh)
+
+
 def _shm_run_threads(size: int, heartbeat: bool = False) -> float:
     """One timed gang: T rounds of {pull, push, wait} per client, all
     ranks as threads of this process (debug mode — see module docstring
@@ -641,6 +913,11 @@ def main():
         # from the codec=none gate (a different protocol mode, like
         # skew); the plain codec=none leg above still holds the record.
         results.append(bench_shm("none", decomp=True))
+    if READERS_SWEEP and MODE in ("shm", "both"):
+        # Many-client serving sweep (TCP event-loop transport): one leg
+        # per reader count; rows are latency-metric, not bandwidth, and
+        # never join the codec=none baseline gate.
+        results.extend(bench_readers(n) for n in READERS_SWEEP)
     if SKEW_SWEEP and MODE in ("shm", "both"):
         # The straggler A/B runs at codec=none (the skew is in the
         # *reply latency*, not the byte volume): rebalance off, then on.
@@ -666,5 +943,7 @@ def main():
 if __name__ == "__main__":
     if "--gang-child" in sys.argv:
         _gang_child()
+    elif "--serve-child" in sys.argv:
+        _serve_child()
     else:
         main()

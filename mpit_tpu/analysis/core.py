@@ -31,6 +31,7 @@ RULES: Dict[str, Tuple[str, str]] = {
     # -- bounded-wait discipline (the mpit_tpu.ft contract) ----------------
     "MT-P201": (ERROR, "aio send/recv in a role file with no deadline=/abort= bound"),
     "MT-P202": (ERROR, "blocking transport send/recv convenience in a role file"),
+    "MT-P203": (ERROR, "blocking socket call / sleep inside an event-loop callback (_el_*)"),
     # -- concurrency (locks, threads, scheduler contract) ------------------
     "MT-C201": (ERROR, "lock-order inversion (A->B here, B->A elsewhere)"),
     "MT-C202": (WARN, "blocking call while holding a lock"),

@@ -415,6 +415,57 @@ def _check_deadline_discipline(files: List[SourceFile]) -> List[Finding]:
     return findings
 
 
+#: direct socket calls that block (or arm blocking) the calling thread —
+#: forbidden inside event-loop callbacks, where one blocked peer would
+#: stall every peer's I/O at once.
+_EL_BLOCKING = {
+    "recv", "recv_into", "recvfrom", "recvmsg", "send", "sendall",
+    "sendmsg", "accept", "connect", "create_connection", "settimeout",
+    "sleep", "_recv_exact",
+}
+
+
+def _check_event_loop_discipline(files: List[SourceFile]) -> List[Finding]:
+    """MT-P203: an event-loop transport multiplexes every peer on one
+    thread, so its selector-dispatch callbacks (the ``_el_*`` naming
+    convention, comm/tcp.py) may only touch sockets through guarded
+    nonblocking helpers (``_nb_*``).  A raw ``recv``/``send``/``accept``
+    — or worse, ``sendall``/``time.sleep``/``settimeout`` — inside a
+    callback turns one slow peer into a stall of the whole rank's I/O.
+    Checked everywhere the convention appears; helpers (non-``_el_``
+    functions) are exempt by design — that is where the guarded raw
+    calls live."""
+    findings: List[Finding] = []
+    for src in files:
+        for qual, fn in iter_functions(src.tree):
+            name = qual.rsplit(".", 1)[-1]
+            if not name.startswith("_el_"):
+                continue
+            for node in _walk_el(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = callee_name(node)
+                if callee in _EL_BLOCKING:
+                    findings.append(src.finding(
+                        "MT-P203", node.lineno,
+                        f"{qual} calls {callee}() inside an event-loop "
+                        "callback — one blocked peer stalls every peer's "
+                        "I/O; route socket work through the _nb_* "
+                        "nonblocking helpers"))
+    return findings
+
+
+def _walk_el(fn: ast.AST):
+    """Walk a callback body without descending into nested defs (their
+    bodies run later, off the dispatch path)."""
+    for child in ast.iter_child_nodes(fn):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _walk_el(child)
+
+
 def _check_spec_drift(files: List[SourceFile]) -> List[Finding]:
     findings: List[Finding] = []
     for src in files:
@@ -487,5 +538,6 @@ def check(files: List[SourceFile]) -> List[Finding]:
         findings += _check_deadlock_shape(fns)
         findings += _check_tag_registration(tag_lines, pairs, files)
     findings += _check_deadline_discipline(files)
+    findings += _check_event_loop_discipline(files)
     findings += _check_spec_drift(files)
     return findings

@@ -32,6 +32,7 @@ from mpit_tpu.ft.wire import (
     ACK_TIMING_WORDS,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
+    FLAG_READONLY,
     FLAG_STALENESS,
     FLAG_TIMING,
     HDR_BYTES,
@@ -59,7 +60,8 @@ __all__ = [
     "LeaseRegistry", "ACTIVE", "EVICTED", "STOPPED",
     "RetryPolicy", "RetryExhausted",
     "HDR_BYTES", "HDR_STALE_BYTES",
-    "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_STALENESS", "FLAG_TIMING",
+    "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_READONLY", "FLAG_STALENESS",
+    "FLAG_TIMING",
     "ACK_TIMING_WORDS", "TIMING_TAIL_BYTES",
     "hdr_bytes", "reply_hdr_bytes",
     "pack_header", "unpack_header", "header_frame", "timed_frame",

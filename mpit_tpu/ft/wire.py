@@ -73,6 +73,19 @@ FLAG_STALENESS = 4
 #: off under shardctl (the 32-byte shard header has no stamp slot).
 FLAG_TIMING = 8
 
+#: INIT v3 flags bit4: READ-ONLY attach (the serving tier,
+#: docs/PROTOCOL.md §8).  The announcing client is a *reader*: it will
+#: only ever send PARAM_REQ / HEARTBEAT / STOP, so the server allocates
+#: no gradient or push staging for it, spawns only the read service,
+#: and answers its reads with status-framed replies — int64
+#: ``[epoch, seq, status, word]`` then (status OK only) the snapshot
+#: frame as its own message, where ``word`` is the snapshot version on
+#: OK and the retry hint in microseconds on BUSY (admission control).
+#: Requires FLAG_FRAMED (the reply echoes the request identity);
+#: readers attach lazily at any point mid-run and may re-announce like
+#: a rejoining incarnation.
+FLAG_READONLY = 16
+
 #: the timing tail: int64 [t_tx_echo_us, t_recv_us, t_ack_us]
 TIMING_TAIL_WORDS = 3
 TIMING_TAIL_BYTES = 8 * TIMING_TAIL_WORDS

@@ -163,6 +163,10 @@ def _rank_row(rank: int, sample: Optional[dict],
         # ring, so the column reads 0 there).
         "p99_s": hist_quantile(m, "mpit_ps_op_seconds", 0.99),
         "send_queue": int(metric_sum(m, "mpit_tcp_send_queue_depth")),
+        # Serving-tier pair (PROTOCOL.md §8): live connection fan-out on
+        # the event-loop transport, and admission-control rejections.
+        "conns": int(metric_sum(m, "mpit_tcp_connections")),
+        "busy": int(metric_sum(m, "mpit_ps_busy_replies_total")),
         "retries": int(metric_sum(m, "mpit_ft_retries_total")),
         "evictions": int(metric_sum(m, "mpit_ft_evictions_total")),
         "shards": int(metric_sum(m, "mpit_shardctl_owned_shards")),
@@ -178,8 +182,9 @@ def _rank_row(rank: int, sample: Optional[dict],
     return row
 
 
-_COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "sendq", "stale",
-            "retry", "evict", "shards", "busy_s", "mapv", "infl")
+_COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "sendq", "conns",
+            "busy", "stale", "retry", "evict", "shards", "busy_s", "mapv",
+            "infl")
 
 
 def render_table(rows: List[Dict[str, object]]) -> str:
@@ -195,6 +200,8 @@ def render_table(rows: List[Dict[str, object]]) -> str:
             f"{ops_s:.1f}" if ops_s is not None else "-",
             f"{p99 * 1000.0:.2f}" if p99 is not None else "-",
             str(row["send_queue"]) if row.get("send_queue") else "-",
+            str(row["conns"]) if row.get("conns") else "-",
+            str(row["busy"]) if row.get("busy") else "-",
             f"{stale:.2f}" if stale is not None else "-",
             str(row["retries"]), str(row["evictions"]),
             str(row["shards"]) if row["shards"] else "-",

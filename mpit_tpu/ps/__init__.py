@@ -19,7 +19,8 @@ same algorithmic tolerance without torn reads.
 from mpit_tpu.ps.sharding import Shard, shard_layout, weighted_layout
 from mpit_tpu.ps.client import ParamClient
 from mpit_tpu.ps.server import ParamServer
+from mpit_tpu.ps.serve import ReaderClient, ServeConfig
 from mpit_tpu.ps import tags
 
 __all__ = ["Shard", "shard_layout", "weighted_layout", "ParamClient",
-           "ParamServer", "tags"]
+           "ParamServer", "ReaderClient", "ServeConfig", "tags"]

@@ -83,6 +83,7 @@ from mpit_tpu.ft import (
     DUP,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
+    FLAG_READONLY,
     FLAG_STALENESS,
     FLAG_TIMING,
     HDR_BYTES,
@@ -108,6 +109,7 @@ from mpit_tpu.obs import (
 )
 from mpit_tpu.obs import clock as obs_clock
 from mpit_tpu.optim.rules import ShardRule, make as make_rule
+from mpit_tpu.ps import serve as _psserve
 from mpit_tpu.ps import tags
 from mpit_tpu.shardctl import migrate as _scmigrate
 from mpit_tpu.shardctl import wire as _scwire
@@ -133,9 +135,27 @@ class ParamServer:
         #                               a name pins it — mismatches fail loudly
         ft: Optional[FTConfig] = None,
         controller_rank: Optional[int] = None,  # shardctl control plane
+        reader_ranks: Optional[list] = None,  # serving tier (§8): READ-ONLY
+        #                                       attachers, not protocol clients
+        serve: Optional["_psserve.ServeConfig"] = None,
     ):
         self.rank = rank
         self.cranks = list(client_ranks)
+        # Serving tier (docs/PROTOCOL.md §8): expected reader ranks.
+        # Readers are outside the client phases (no seeding, no grad
+        # services) — each gets a lazy attach listener, a read service
+        # behind the admission budget, and a stop/lease slot, so the
+        # gang ends when every writer AND every expected reader is
+        # terminal.
+        self.readers = list(reader_ranks or [])
+        self._reader_set = set(self.readers)
+        if self._reader_set & set(self.cranks):
+            raise ValueError(
+                f"reader_ranks {sorted(self._reader_set & set(self.cranks))}"
+                " overlap client_ranks — a rank is a writer or a reader,"
+                " not both")
+        self.serve_cfg = (serve if serve is not None
+                          else _psserve.ServeConfig.from_env())
         self.transport = transport
         self.rule = make_rule(rule) if isinstance(rule, str) else rule
         self.sched = scheduler or Scheduler()
@@ -170,10 +190,17 @@ class ParamServer:
         # rejoin/eviction so stale loops abort), framed/heartbeat flags
         # from INIT v3, and the reply staging the framed paths need.
         self.ft = ft if ft is not None else FTConfig.from_env()
-        self.leases = LeaseRegistry(self.cranks, ttl_s=self.ft.lease_ttl_s)
+        self.leases = LeaseRegistry(self.cranks + self.readers,
+                                    ttl_s=self.ft.lease_ttl_s)
         self.dedup = DedupTable()
         self._framed: Dict[int, bool] = {}
         self._hb: Dict[int, bool] = {}
+        # READ-ONLY postures (FLAG_READONLY, §8) + the admission
+        # budget's live in-flight accounting: reply bytes/count queued
+        # to the transport but not yet accepted, across all readers.
+        self._readonly: Dict[int, bool] = {}
+        self._serve_inflight_bytes = 0
+        self._serve_inflight_reads = 0
         # Staleness telemetry (FLAG_STALENESS, negotiated per pair like
         # framing): frames from these clients carry the 24-byte
         # [epoch, seq, version] header; PARAM replies are stamped with
@@ -187,8 +214,9 @@ class ParamServer:
         # estimator consumes, and their heartbeats are echoed back on
         # HEARTBEAT_ECHO so the estimate refreshes between ops.
         self._timing: Dict[int, bool] = {}
-        self._gen: Dict[int, int] = {c: 0 for c in self.cranks}
-        self._svc_live: Dict[int, int] = {c: 0 for c in self.cranks}
+        self._gen: Dict[int, int] = {c: 0 for c in self.cranks + self.readers}
+        self._svc_live: Dict[int, int] = {c: 0
+                                          for c in self.cranks + self.readers}
         self._param_send: Dict[int, np.ndarray] = {}
         self._ack_send: Dict[int, np.ndarray] = {}
         self._req_buf: Dict[int, np.ndarray] = {}
@@ -226,6 +254,8 @@ class ParamServer:
             "mpit_ps_snapshot_copies_total", rank=_r)
         self._m_snap_hits = _m.counter("mpit_ps_snapshot_hits_total", rank=_r)
         self._m_ckpts = _m.counter("mpit_ps_ckpts_written_total", rank=_r)
+        self._m_busy = _m.counter("mpit_ps_busy_replies_total", rank=_r)
+        self._m_readers = _m.gauge("mpit_ps_readers", rank=_r)
         self._m_evictions = _m.counter("mpit_ft_evictions_total", rank=_r)
         self._m_sc_nacks = _m.counter("mpit_shardctl_nacks_sent_total",
                                       rank=_r)
@@ -297,6 +327,9 @@ class ParamServer:
             "snap_version": self._snap_version,
             "map_version": getattr(self.smap, "version", None),
             "owned_shards": sorted(self._slots),
+            "readers": int(self._m_readers.value),
+            "busy_replies": int(self._m_busy.value),
+            "serve_inflight_bytes": self._serve_inflight_bytes,
             "clients": {
                 str(c): {
                     "state": self.leases.state(c),
@@ -353,6 +386,11 @@ class ParamServer:
     def ckpts_written(self) -> int:
         return int(self._m_ckpts.value)
 
+    @property
+    def busy_replies(self) -> int:
+        """Admission-control rejections issued (serving tier, §8)."""
+        return int(self._m_busy.value)
+
     # -- shardctl reads (tests / observability) ------------------------------
 
     @property
@@ -401,6 +439,24 @@ class ParamServer:
                 "expected 16 (legacy [offset, size]), 24 "
                 "([offset, size, codec_id]) or 40 (v3 + [epoch, flags])"
             )
+        # READ-ONLY attach (serving tier, §8): the posture is a property
+        # of the *rank role*, so a reader announcing as a writer (or
+        # vice versa) is a misconfiguration, caught here loudly.
+        ro = bool(flags & FLAG_READONLY)
+        if ro and crank not in self._reader_set:
+            raise ValueError(
+                f"rank {crank} announced FLAG_READONLY but is not in this "
+                f"server's reader_ranks {sorted(self._reader_set)}")
+        if crank in self._reader_set and not ro:
+            raise ValueError(
+                f"rank {crank} is a reader rank but announced without "
+                "FLAG_READONLY — readers attach with the read-only posture")
+        if ro and not (flags & FLAG_FRAMED):
+            raise ValueError(
+                f"reader {crank} announced FLAG_READONLY without "
+                "FLAG_FRAMED — status-framed replies echo the request "
+                "identity")
+        self._readonly[crank] = ro
         codec = codec_mod.by_wire_id(wire_id)
         if self._codec_pin is not None and codec.name != self._codec_pin:
             raise ValueError(
@@ -431,10 +487,12 @@ class ParamServer:
         # Staleness telemetry only rides the framed wire: the version
         # word extends the [epoch, seq] header, so a FLAG_STALENESS
         # without FLAG_FRAMED negotiates off (nothing to extend).
-        self._stale_track[crank] = (self._framed[crank]
+        # Readers negotiate both extensions off: their replies use the
+        # §8 status header, which carries the version in its own word.
+        self._stale_track[crank] = (self._framed[crank] and not ro
                                     and bool(flags & FLAG_STALENESS))
         # Same rule for the timing extension: no frame, no stamp slot.
-        self._timing[crank] = (self._framed[crank]
+        self._timing[crank] = (self._framed[crank] and not ro
                                and bool(flags & FLAG_TIMING))
         self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
         return codec
@@ -444,6 +502,11 @@ class ParamServer:
         map replaces the per-pair (offset, size); owned shards become
         slots.  Shardctl implies framing — re-routable ops need the
         retry/dedup identity under them."""
+        if self.readers:
+            raise ValueError(
+                "the serving tier (reader_ranks) and shardctl are "
+                "mutually exclusive for now — readers address a static "
+                "shard cut")
         codec_id, epoch, flags, smap = _scwire.parse_init_v4(raw)
         if not (flags & FLAG_FRAMED):
             raise ValueError(
@@ -527,6 +590,16 @@ class ParamServer:
         """(Re)allocate every per-client staging buffer for the client's
         negotiated codec + framing — initial INIT and rejoin both land
         here, so a rejoining incarnation may change codec freely."""
+        if self._readonly.get(crank):
+            # Readers cost a request header, not a shard: no gradient
+            # or push staging, no ack buffers — the read replies are
+            # fresh 32-byte headers plus zero-copy views of the shared
+            # snapshot cache.
+            self._codecs[crank] = codec
+            self._req_buf[crank] = np.zeros(2, np.int64)
+            if self._hb.get(crank):
+                self._hb_buf[crank] = np.zeros(2, np.int64)
+            return
         if self._sc:
             # Shardctl frames are shard-addressed and variable-size per
             # shard, so the data paths receive by allocation — the only
@@ -870,6 +943,191 @@ class ParamServer:
             )
             self._m_served.inc()
             span.end("served")
+
+    # -- serving tier: READ-ONLY readers + admission control (§8) ------------
+
+    def _update_reader_gauge(self) -> None:
+        live = sum(1 for r in self.readers
+                   if r in self._codecs and not self.leases.gone(r))
+        self._m_readers.set(live)
+
+    def _dispatch_recv(self, crank: int, tag: int, out=None):
+        """Receive a message the dispatcher's probe already saw (fully
+        assembled, so this completes without waiting on the peer)."""
+        handle = self.transport.irecv(crank, tag, out=out)
+        while not self.transport.test(handle):
+            yield EXEC
+        return self.transport.payload(handle)
+
+    def _reader_dispatcher(self):
+        """ONE task serves every reader (serving tier, §8).  A
+        per-reader service trio would put O(attached readers) perpetual
+        tasks on the cooperative scheduler — at 512 readers every
+        scheduler pass walks ~1500 parked generators, and per-op
+        latency scales with attachment, not load.  Instead this single
+        task probes each reader's channels nonblockingly per scan
+        (attach/re-attach INIT, STOP, HEARTBEAT, read requests) and
+        spawns one bounded *reply task* per granted read: the scheduler
+        holds O(in-flight replies) tasks — and in-flight is exactly
+        what the admission budget bounds, so admission control is also
+        what keeps the scheduler flat under fan-out."""
+        reply_live: Dict[int, bool] = {r: False for r in self.readers}
+        self._reader_reply_live = reply_live  # introspection/tests
+        scan = 0
+        while self.live.on:
+            progressed = False
+            # Rare-event probes (re-attach, STOP, beats) are staggered
+            # over 8 scans so a steady-state scan costs ~one probe per
+            # reader — the hot path is PARAM_REQ, everything else can
+            # tolerate a few scans of latency.
+            slot = scan & 7
+            for crank in self.readers:
+                if reply_live[crank]:
+                    # FIFO per reader: one reply (or re-attach gate) at
+                    # a time — two in-flight replies to one reader
+                    # could interleave their header/body pairs.
+                    continue
+                attached = crank in self._codecs
+                slow_turn = (crank & 7) == slot
+                try:
+                    if ((not attached or slow_turn)
+                            and self.transport.iprobe(crank, tags.INIT)):
+                        payload = yield from self._dispatch_recv(
+                            crank, tags.INIT)
+                        codec = self._negotiate(crank, payload)
+                        self._gen[crank] += 1
+                        self.leases.rejoin(crank, self.leases.epoch(crank))
+                        self.leases.arm(crank, self.leases.epoch(crank),
+                                        heartbeats=self._hb.get(crank, False))
+                        self._alloc_client(crank, codec)
+                        self._update_reader_gauge()
+                        attached = True
+                        progressed = True
+                        self.log.info(
+                            "reader %d attached (epoch %d, gen %d)",
+                            crank, self.leases.epoch(crank),
+                            self._gen[crank])
+                    if not attached or self.leases.gone(crank):
+                        continue
+                    if slow_turn and self.transport.iprobe(crank, tags.STOP):
+                        yield from self._dispatch_recv(crank, tags.STOP)
+                        self.leases.stop(crank)
+                        self._update_reader_gauge()
+                        progressed = True
+                        if self.leases.all_done():
+                            self.live.stop()
+                        continue
+                    if slow_turn and self._hb.get(crank):
+                        while self.transport.iprobe(crank, tags.HEARTBEAT):
+                            beat = yield from self._dispatch_recv(
+                                crank, tags.HEARTBEAT, out=self._hb_buf[crank])
+                            if beat is None:
+                                break
+                            self._m_hb_seen.inc()
+                            self.leases.renew(crank, int(beat[0]))
+                    if self.transport.iprobe(crank, tags.PARAM_REQ):
+                        yield from self._dispatch_read(crank, reply_live)
+                        progressed = True
+                except RuntimeError:
+                    # Torn connection (the transport's fail-loud probe):
+                    # the reader is gone without a STOP — its lease (when
+                    # armed) evicts it; a replacement attaches through a
+                    # fresh INIT on a revived channel.
+                    continue
+            scan += 1
+            if progressed:
+                yield EXEC  # hot: scan again next step
+            else:
+                # Idle scan: pace the next one — two servers
+                # busy-scanning N channels would eat the very core the
+                # gang's replies are produced on (the IDLE_USEC lesson).
+                if not (yield from aio_sleep(0.002, live=self.live)):
+                    return
+
+    def _dispatch_read(self, crank: int, reply_live: Dict[int, bool]):
+        """Admit one read request: grant it a reply task, or answer
+        BUSY-with-retry-hint when the in-flight budget is spent."""
+        codec = self._codecs[crank]
+        cfg = self.serve_cfg
+        req = yield from self._dispatch_recv(crank, tags.PARAM_REQ,
+                                             out=self._req_buf[crank])
+        if req is None:
+            return
+        epoch, seq = int(req[0]), int(req[1])
+        span = self._spans.op("PARAM", peer=crank, side="server",
+                              rank=self.rank)
+        span.note(epoch=epoch, seq=seq, reader=1)
+        if epoch < self.leases.epoch(crank):
+            self._m_stale.inc()  # dead incarnation's request
+            span.end("stale")
+            return
+        self.leases.renew(crank, epoch)
+        gen = self._gen[crank]
+        nbytes = (self.size * np.dtype(self.dtype).itemsize
+                  if codec.identity else codec.wire_nbytes(self.size))
+        # An idle rank always grants (a frame larger than the whole
+        # budget must not be rejectable forever); past that, the budget
+        # bounds what may queue behind in-flight replies.
+        if self._serve_inflight_reads > 0 and (
+                self._serve_inflight_bytes + nbytes > cfg.budget_bytes
+                or (cfg.budget_reads > 0
+                    and self._serve_inflight_reads >= cfg.budget_reads)):
+            self._m_busy.inc()
+            hint = cfg.hint_us(self._serve_inflight_bytes)
+            span.note(hint_us=hint)
+            span.mark("send")
+            header = _psserve.serve_reply(epoch, seq, _scwire.BUSY, hint)
+            reply_live[crank] = True
+            self.sched.spawn(
+                self._serve_reply(crank, gen, span, header, None, 0,
+                                  reply_live),
+                name=f"serve_busy:{crank}")
+            return
+        span.mark("snapshot")
+        wire = self._snapshot_wire(codec)
+        header = _psserve.serve_reply(epoch, seq, _scwire.OK,
+                                      self._snap_version)
+        self._serve_inflight_bytes += nbytes
+        self._serve_inflight_reads += 1
+        reply_live[crank] = True
+        self.sched.spawn(
+            self._serve_reply(crank, gen, span, header, wire, nbytes,
+                              reply_live),
+            name=f"serve_reply:{crank}")
+
+    def _serve_reply(self, crank: int, gen: int, span, header,
+                     body, nbytes: int, reply_live: Dict[int, bool]):
+        """One granted (or BUSY) reply: the 32-byte status header, then
+        — on a grant — the snapshot frame as its own message.  The body
+        is a zero-copy view of this version's cached frame, so N
+        readers of one version share one device->host copy and one
+        encode however many connections are attached.  A reader that
+        dies mid-reply costs this task, never the server."""
+        span.mark("send")
+        try:
+            yield from aio_send(self.transport, header, crank, tags.PARAM,
+                                live=self.live,
+                                abort=self._svc_abort(crank, gen))
+            if body is not None:
+                yield from aio_send(self.transport, body, crank, tags.PARAM,
+                                    live=self.live,
+                                    abort=self._svc_abort(crank, gen))
+        except (RuntimeError, DeadlineExceeded) as exc:
+            # Dead reader mid-reply (transport fail-loud): drop the
+            # reply; the lease reaper / re-attach path owns the rank.
+            self.log.debug("reply to reader %d dropped: %r", crank, exc)
+            span.end("aborted")
+            return
+        finally:
+            if body is not None:
+                self._serve_inflight_bytes -= nbytes
+                self._serve_inflight_reads -= 1
+            reply_live[crank] = False
+        if body is not None:
+            self._m_served.inc()
+            span.end("served")
+        else:
+            span.end("busy")
 
     def _recv_grad(self, crank: int, gen: int = 0):
         """Loop: receive gradient frame, decode+apply the shard rule in
@@ -1386,6 +1644,8 @@ class ParamServer:
         if got is None:
             return
         self.leases.stop(crank)
+        if crank in self._reader_set:
+            self._update_reader_gauge()
         if self.leases.all_done():
             self.live.stop()
 
@@ -1409,6 +1669,8 @@ class ParamServer:
                 self._m_evictions.inc()
                 self._gen[crank] += 1  # stale loops abort at next poll
                 self._release_client(crank)
+                if crank in self._reader_set:
+                    self._update_reader_gauge()
                 # Postmortem: the gang just lost a member — dump the
                 # recent-event ring + live task table (obs/flight.py;
                 # no-op when obs is disabled).
@@ -1438,7 +1700,10 @@ class ParamServer:
                 "timing": self._timing.get(c, False),
                 "epoch": self.leases.epoch(c),
             }
-            for c in self._codecs
+            for c in self._codecs if c not in self._reader_set
+            # Readers are excluded on purpose: they re-attach through
+            # the perpetual listener, so a restarted server need not
+            # carry their negotiation.
         }
 
     def save_state(self, directory) -> "str":
@@ -1615,6 +1880,12 @@ class ParamServer:
             )
         for crank in self.cranks:
             self._spawn_services(crank)
+        if self.readers:
+            # Serving tier: ONE dispatcher task for every reader —
+            # readers attach lazily, any time mid-run, and the
+            # scheduler's task count stays O(in-flight replies).
+            self.sched.spawn(self._reader_dispatcher(),
+                             name="reader_dispatcher")
         if self.ft.server_rejoin:
             for crank in self.cranks:
                 self.sched.spawn(self._init_listener(crank),

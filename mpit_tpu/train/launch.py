@@ -99,6 +99,20 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     shardctl=False,
     shardctl_ratio=3.0,
     shardctl_lease_ttl_s=0.0,
+    # Serving tier (mpit_tpu.ps.serve; docs/PROTOCOL.md §8): the LAST
+    # serve_readers ranks become READ-ONLY readers — they attach to the
+    # servers with the lightweight read-only posture, pull the current
+    # params serve_rounds times (pacing serve_interval_s apart), assert
+    # the observed snapshot version is monotone, and stop.  Servers run
+    # the admission budget (serve_budget_mb in-flight reply bytes;
+    # serve_budget_reads optionally bounds the reply count) and answer
+    # over-budget reads BUSY-with-retry-hint.  Requires
+    # ft_op_deadline_s > 0 (BUSY recovery rides the retry machinery).
+    serve_readers=0,
+    serve_rounds=10,
+    serve_interval_s=0.05,
+    serve_budget_mb=64.0,
+    serve_budget_reads=0,
 )
 
 
@@ -159,6 +173,75 @@ def server_rule_for(cfg: Config) -> Any:
     return rules_mod.make("add")  # downpour/easgd/eamsgd ship pre-scaled deltas
 
 
+def serve_cfg_for(cfg: Config):
+    """The serving tier's admission budget from the launch config."""
+    from mpit_tpu.ps import ServeConfig
+
+    return ServeConfig.from_env(
+        budget_bytes=int(float(cfg.get("serve_budget_mb", 64.0)) * (1 << 20)),
+        budget_reads=int(cfg.get("serve_budget_reads", 0) or 0),
+    )
+
+
+def _serve_vec_len(cfg: Config, rank: int) -> int:
+    """The flat parameter-vector length a reader must mirror — derived
+    exactly the way the trainer derives it (same model ctor + flatten),
+    so the reader's shard announcement matches the writers' cut."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpit_tpu.data.mnist import load_mnist
+    from mpit_tpu.models import MnistCNN, flatten_module
+    from mpit_tpu.train.trainer import MODELS
+
+    full = TRAINER_DEFAULTS.merged(cfg.to_dict())
+    x_train = load_mnist(side=full.side)[0][0]
+    if full.model == "cnn":
+        module = MnistCNN(num_classes=10, side=full.side)
+    else:
+        module = MODELS[full.model](num_classes=10)
+    rng = jax.random.PRNGKey(full.seed + rank)
+    sample = jnp.asarray(x_train[:2], jnp.dtype(full.dtype))
+    return int(flatten_module(module, rng, sample).w0.size)
+
+
+def run_reader(rank: int, sranks: List[int], cfg: Config,
+               transport: Any) -> Dict[str, Any]:
+    """One READ-ONLY reader rank (serve mode): attach, pull the current
+    params ``serve_rounds`` times at ``serve_interval_s`` pacing, check
+    version monotonicity, stop."""
+    import numpy as np
+
+    from mpit_tpu.ps import ReaderClient
+
+    log = get_logger("serve", rank)
+    rc = ReaderClient(
+        rank, sranks, transport,
+        codec=str(cfg.get("codec", "") or "") or None,
+        ft=ft_from_cfg(cfg),
+    )
+    mirror = np.zeros(_serve_vec_len(cfg, rank),
+                      np.dtype(str(cfg.get("dtype", "float32"))))
+    rc.start(mirror)
+    rounds = int(cfg.get("serve_rounds", 10))
+    interval = float(cfg.get("serve_interval_s", 0.05))
+    for _ in range(rounds):
+        rc.read_params()
+        if interval > 0:
+            time.sleep(interval)
+    rc.stop()
+    log.info("reader done: %d reads, monotone=%s, busy honored %d",
+             rc.reads_done, rc.monotone, rc.busy_honored)
+    return {
+        "role": "reader",
+        "reads": rc.reads_done,
+        "monotone": bool(rc.monotone),
+        "busy_honored": rc.busy_honored,
+        "retries": rc.retries,
+        "versions": {str(k): v for k, v in rc.versions.items()},
+    }
+
+
 def run_rank(
     rank: int,
     size: int,
@@ -182,6 +265,24 @@ def run_rank(
     sc_on = bool(cfg.get("shardctl", False))
     ctl_rank: Optional[int] = None
     role_size = size
+    n_readers = int(cfg.get("serve_readers", 0) or 0)
+    reader_ranks: List[int] = []
+    if n_readers:
+        if sc_on:
+            raise ValueError("serve_readers and shardctl are mutually "
+                             "exclusive for now")
+        if str(cfg.get("tester", "none")) != "none":
+            raise ValueError("serve_readers and a tester rank are mutually "
+                             "exclusive for now (both claim edge ranks)")
+        if float(cfg.get("ft_op_deadline_s", 0) or 0) <= 0:
+            raise ValueError("serve_readers needs --ft_op_deadline_s > 0: "
+                             "BUSY recovery rides the FT retry machinery")
+        if size - n_readers < 2:
+            raise ValueError(
+                f"serve_readers={n_readers} leaves {size - n_readers} "
+                "role ranks; need >= 1 server + >= 1 worker")
+        role_size = size - n_readers
+        reader_ranks = list(range(role_size, size))
     if sc_on:
         if str(cfg.get("tester", "none")) != "none":
             raise ValueError("shardctl and a tester rank are mutually "
@@ -198,6 +299,8 @@ def run_rank(
         role_size, cfg.get("master_freq", 2), cfg.get("tester", "none")
     )
     single_mode = str(cfg.opt).endswith("-single")
+    if rank in reader_ranks:
+        return run_reader(rank, sranks, cfg, transport)
     if sc_on and rank == ctl_rank:
         from mpit_tpu.shardctl import RebalancePolicy, ShardController
 
@@ -231,6 +334,8 @@ def run_rank(
             codec=str(cfg.get("codec", "") or "") or None,
             ft=ft,
             controller_rank=ctl_rank,
+            reader_ranks=reader_ranks or None,
+            serve=serve_cfg_for(cfg) if reader_ranks else None,
         )
         if bool(cfg.get("resume", False)):
             import pathlib
@@ -285,9 +390,13 @@ def expected_role(rank: int, size: int, cfg: Config) -> str:
     sc_on = bool(cfg.get("shardctl", False))
     if sc_on and rank == size - 1:
         return "controller"
+    n_readers = int(cfg.get("serve_readers", 0) or 0)
+    if n_readers and rank >= size - n_readers:
+        return "reader"
     try:
         sranks, _cranks, tester_rank = assign_roles(
-            size - 1 if sc_on else size, int(cfg.get("master_freq", 2)),
+            size - 1 if sc_on else size - n_readers,
+            int(cfg.get("master_freq", 2)),
             str(cfg.get("tester", "none")))
     except ValueError:
         return ""
@@ -337,6 +446,7 @@ def device_env_overrides(cfg: Config, size: int) -> Dict[int, Dict[str, str]]:
         # env_overrides instead.  Under shardctl the last rank is the
         # controller (a pure host role, never the accelerator owner).
         role_size = size - 1 if bool(cfg.get("shardctl", False)) else size
+        role_size -= int(cfg.get("serve_readers", 0) or 0)  # readers: host roles
         sranks, cranks, tester = assign_roles(
             role_size, int(cfg.get("master_freq", 2)),
             str(cfg.get("tester", "none"))
@@ -414,7 +524,8 @@ def main(argv: Optional[List[str]] = None) -> None:
 
 def _summarize(result: Dict[str, Any]) -> Dict[str, Any]:
     keep = {"role", "final_test_err", "time_to_target", "elapsed",
-            "grads_applied", "params_served", "best_test_err"}
+            "grads_applied", "params_served", "best_test_err",
+            "reads", "monotone", "busy_honored"}
     return {k: v for k, v in result.items() if k in keep}
 
 

@@ -1,0 +1,16 @@
+"""Seeded event-loop callbacks (mtlint fixture — parsed, never run)."""
+
+import time
+
+
+class BadLoop:
+    def _el_on_readable(self, conn):
+        # MT-P203: raw blocking recv inside a selector-dispatch callback.
+        data = conn.sock.recv(65536)
+        # MT-P203: sleeping the loop thread stalls every peer at once.
+        time.sleep(0.01)
+        return data
+
+    def _el_on_writable(self, conn, payload):
+        # MT-P203: sendall blocks the whole loop on one peer's backpressure.
+        conn.sock.sendall(payload)

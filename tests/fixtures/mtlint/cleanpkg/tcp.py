@@ -1,0 +1,25 @@
+"""Clean event-loop callbacks (mtlint fixture — zero findings): raw
+socket calls live in guarded _nb_* helpers; _el_* callbacks only ever
+dispatch through them."""
+
+
+class CleanLoop:
+    @staticmethod
+    def _nb_recv_into(sock, view):
+        try:
+            return sock.recv_into(view)
+        except BlockingIOError:
+            return None
+
+    @staticmethod
+    def _nb_send(sock, bufs):
+        try:
+            return sock.sendmsg(bufs)
+        except BlockingIOError:
+            return None
+
+    def _el_on_readable(self, conn):
+        return self._nb_recv_into(conn.sock, conn.view)
+
+    def _el_on_writable(self, conn):
+        return self._nb_send(conn.sock, conn.bufs)

@@ -89,6 +89,15 @@ class TestSeededViolations:
         hits = bad.get("MT-P202", [])
         assert [(f.path, f.line) for f in hits] == [("server.py", 22)]
 
+    def test_event_loop_blocking_detected(self, bad):
+        # MT-P203: raw recv + time.sleep + sendall inside _el_* callbacks
+        # (tcp.py fixture); the cleanpkg _nb_*-helper shape must be silent
+        # (asserted by test_clean_fixture_is_silent).
+        hits = bad.get("MT-P203", [])
+        assert {(f.path, f.line) for f in hits} == {
+            ("tcp.py", 9), ("tcp.py", 11), ("tcp.py", 16)}
+        assert all("event-loop callback" in f.message for f in hits)
+
     def test_yield_under_lock_detected(self, bad):
         hits = bad.get("MT-C203", [])
         assert [(f.path, f.line) for f in hits] == [("locks.py", 31)]

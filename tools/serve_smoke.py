@@ -1,0 +1,162 @@
+"""CI serve-smoke (docs/PROTOCOL.md §8): a 2-server gang with 64
+simulated READ-ONLY readers on the epoll event-loop transport, under a
+deliberately tiny admission budget.
+
+Asserts, loudly:
+- every reader's observed snapshot version is monotone and every read
+  decodes the exact served bytes;
+- at least one BUSY-with-retry-hint was issued AND recovered from
+  (readers honored hints through the backoff loop and still completed
+  every read);
+- each server rank held all 65 connections on ONE I/O thread;
+- the N-readers=1-copy snapshot invariant held;
+- the obs trace of the whole gang validates.
+
+Usage: python tools/serve_smoke.py <trace_out.json>
+"""
+
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from mpit_tpu import obs  # noqa: E402
+from mpit_tpu.comm.tcp import TcpTransport, allocate_local_addresses  # noqa: E402
+from mpit_tpu.ft import FTConfig  # noqa: E402
+from mpit_tpu.obs import trace as obs_trace  # noqa: E402
+from mpit_tpu.ps import (  # noqa: E402
+    ParamClient,
+    ParamServer,
+    ReaderClient,
+    ServeConfig,
+)
+
+NSERVERS, NREADERS, ROUNDS, SIZE = 2, 64, 3, 16384
+
+
+def main(trace_path: str) -> int:
+    obs.configure(enabled=True, reset=True)
+    core = NSERVERS + 1
+    nranks = core + NREADERS
+    addrs, socks = allocate_local_addresses(core)
+    addrs += ["127.0.0.1:0"] * NREADERS  # readers never listen
+    sranks = list(range(NSERVERS))
+    wrank = NSERVERS
+    readers = list(range(core, nranks))
+    tr = {}
+
+    def build(r):
+        tr[r] = TcpTransport(r, nranks, addrs, listener=socks[r],
+                             reconnect=60.0, dial_peers=list(range(r)))
+
+    ths = [threading.Thread(target=build, args=(r,)) for r in range(core)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(60)
+    assert len(tr) == core, "core mesh construction hung"
+
+    # Tiny budget: a 64-reader burst must draw BUSY and recover.
+    servers = [ParamServer(r, [wrank], tr[r], rule="add",
+                           reader_ranks=readers,
+                           serve=ServeConfig(budget_reads=2,
+                                             budget_bytes=1 << 30))
+               for r in sranks]
+    sth = [threading.Thread(target=s.start, daemon=True) for s in servers]
+    for t in sth:
+        t.start()
+
+    client = ParamClient(wrank, sranks, tr[wrank], seed_servers=True,
+                         ft=FTConfig(op_deadline_s=60.0))
+    param = np.arange(SIZE, dtype=np.float32)
+    grad = np.full(SIZE, 0.25, np.float32)
+    client.start(param, grad)
+
+    failures = []
+
+    def run_batch(batch):
+        clients = {}
+        mirrors = {}
+        try:
+            for r in batch:
+                t = TcpTransport(r, nranks, addrs, reconnect=60.0,
+                                 dial_peers=sranks, listen=False,
+                                 connect_timeout=120.0)
+                clients[r] = (t, ReaderClient(r, sranks, t,
+                                              ft=FTConfig(op_deadline_s=60.0)))
+                mirrors[r] = np.zeros(SIZE, np.float32)
+                clients[r][1].start(mirrors[r])
+            for _ in range(ROUNDS):
+                # Burst: every reader in the batch fires at once — this
+                # is what must overflow the 2-read budget into BUSY.
+                for r in batch:
+                    clients[r][1].async_read_params()
+                pending = set(batch)
+                while pending:
+                    for r in list(pending):
+                        if not clients[r][1].poll():
+                            pending.discard(r)
+            for r in batch:
+                rc = clients[r][1]
+                if not rc.monotone:
+                    failures.append(f"reader {r}: version went backwards")
+                if rc.reads_done == 0 and not rc.versions:
+                    failures.append(f"reader {r}: never completed a read")
+                rc.stop()
+        except Exception as exc:  # noqa: BLE001 — smoke must report, not hang
+            failures.append(f"batch {batch[:2]}...: {exc!r}")
+        finally:
+            for r, (t, _rc) in clients.items():
+                t.close()
+        return sum(c[1].busy_honored for c in clients.values()), mirrors
+
+    batches = [readers[i::2] for i in range(2)]
+    results = []
+    bth = [threading.Thread(target=lambda b=b: results.append(run_batch(b)))
+           for b in batches]
+    for t in bth:
+        t.start()
+    for t in bth:
+        t.join(300)
+        assert not t.is_alive(), "reader batch hung"
+
+    # A couple of committed versions while readers pull.
+    client.async_send_grad()
+    client.wait()
+    client.stop()
+    for t in sth:
+        t.join(60)
+        assert not t.is_alive(), "server never stopped"
+
+    assert not failures, failures
+    busy_issued = sum(s.busy_replies for s in servers)
+    busy_honored = sum(r[0] for r in results)
+    assert busy_issued >= 1, "64-reader burst never drew a BUSY"
+    assert busy_honored >= 1, "no reader recovered from a BUSY"
+    for s in servers:
+        # One I/O thread held every reader connection.
+        alive = [t for t in s.transport._threads if t.is_alive()]
+        assert len(alive) <= 1, [t.name for t in alive]
+        assert s.snapshot_copies <= s._snap_version, (
+            s.snapshot_copies, s._snap_version)
+    for _busy, mirrors in results:
+        for r, mirror in mirrors.items():
+            assert np.array_equal(mirror, param), f"reader {r} bytes differ"
+    for r in range(core):
+        tr[r].close()
+
+    obs_trace.write_rank_trace(trace_path, 0, role="serve_smoke")
+    report = obs_trace.validate_trace(trace_path)
+    print(f"serve-smoke OK: {NREADERS} readers x {ROUNDS} bursts, "
+          f"busy issued/honored {busy_issued}/{busy_honored}, "
+          f"snapshot copies {[s.snapshot_copies for s in servers]} for "
+          f"versions {[s._snap_version for s in servers]}, trace "
+          f"events={report.get('events')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
+                  "/tmp/mpit_serve_smoke_trace.json"))
