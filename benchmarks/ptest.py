@@ -128,6 +128,22 @@ READER_MB = float(os.environ.get("MPIT_BENCH_READER_MB", "0.25"))
 READER_ROUNDS = int(os.environ.get("MPIT_BENCH_READER_ROUNDS", "6"))
 READER_INTERVAL = float(os.environ.get("MPIT_BENCH_READER_INTERVAL_S", "1.0"))
 READER_BUDGET_MB = float(os.environ.get("MPIT_BENCH_READER_BUDGET_MB", "8"))
+# MPIT_BENCH_ELASTIC=1: the shrink/grow sweep (ISSUE 9, PROTOCOL.md
+# §9) — three codec=none shm legs at 1 -> 2 -> 1 servers, capturing the
+# steady-state capacity the gang gains (and gives back) with each
+# membership size.  The *transitions* are covered by the elastic tests
+# and smoke (bitwise + bounded); the bench answers "what is a member
+# worth", which is what an autoscaler trades against preemption risk.
+# Rows are tagged metric=..._elastic and never join the codec=none
+# baseline gate (a 1-server leg is half the serving hardware).  Each
+# server member applies at MPIT_BENCH_ELASTIC_MBS (default 300 MB/s, 0
+# = unthrottled): the **member-capacity model** — on a time-shared
+# 1-core bench host, N server processes cannot add real compute, so an
+# unthrottled sweep measures host contention, not membership; the
+# throttle makes each member a fixed-capacity resource, which is
+# exactly the quantity an autoscaler trades against preemption risk.
+ELASTIC_SWEEP = os.environ.get("MPIT_BENCH_ELASTIC", "") not in ("", "0")
+ELASTIC_MBS = float(os.environ.get("MPIT_BENCH_ELASTIC_MBS", "300"))
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
 # (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
@@ -154,7 +170,8 @@ def bench_ici() -> dict:
 
 def bench_shm(codec: str = "", heartbeat: bool = False,
               obs: bool = False, skew_rebalance=None,
-              status: bool = False, decomp: bool = False) -> dict:
+              status: bool = False, decomp: bool = False,
+              throttle_mbs: float = 0.0) -> dict:
     """One shm PS push/pull measurement; ``codec`` overrides
     MPIT_PS_CODEC for the gang (read at client/server construction);
     ``heartbeat`` arms client beacons + the server lease registry;
@@ -196,7 +213,8 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
                                skew_rebalance=skew_rebalance,
                                status_port=STATUS_PORT if status else None,
                                status_polls=polls,
-                               decomp_out=decomp_out if decomp else None)
+                               decomp_out=decomp_out if decomp else None,
+                               throttle_mbs=throttle_mbs)
                 for _ in range(REPS)]
     else:
         runs = [_shm_run_threads(size, heartbeat=heartbeat)
@@ -234,6 +252,39 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
     return row
 
 
+def bench_elastic() -> list:
+    """The 1 -> 2 -> 1 server sweep (MPIT_BENCH_ELASTIC): one
+    codec=none leg per membership phase, same clients/payload/rounds
+    throughout, so the three rows read as "throughput tracking gang
+    size".  Runs by retargeting the module's server-count knob — the
+    legs are steady-state gangs at each size (what capacity each
+    membership is worth); scale-*transition* correctness and
+    boundedness are the elastic test suite's job."""
+    global NSERVERS
+    saved = NSERVERS
+    rows = []
+    try:
+        for phase, n in (("start", 1), ("grown", 2), ("shrunk", 1)):
+            NSERVERS = n
+            row = bench_shm("none", throttle_mbs=ELASTIC_MBS)
+            row["metric"] = "ps_pushpull_bandwidth_elastic"
+            row["elastic"] = 1
+            row["phase"] = phase
+            if ELASTIC_MBS > 0:
+                row["member_capacity_mbs"] = ELASTIC_MBS
+            rows.append(row)
+    finally:
+        NSERVERS = saved
+    by_phase = {r["phase"]: r["value"] for r in rows}
+    _log(f"[elastic] 1->2->1 sweep: {by_phase} MB/s")
+    if by_phase["grown"] <= max(by_phase["start"], by_phase["shrunk"]):
+        _log("[elastic] WARNING: the grown (2-server) leg did not beat "
+             "the 1-server legs — server CPU was not the bottleneck at "
+             "this payload/host; prefer MPIT_BENCH_MB large enough that "
+             "apply+encode dominates")
+    return rows
+
+
 _GANG_SEQ = [0]  # unique shm namespace per gang within this process
 
 
@@ -269,7 +320,7 @@ def _status_poller(port: int, stop, polls) -> None:
 def _shm_run_procs(size: int, heartbeat: bool = False,
                    obs: bool = False, skew_rebalance=None,
                    status_port=None, status_polls=None,
-                   decomp_out=None) -> float:
+                   decomp_out=None, throttle_mbs: float = 0.0) -> float:
     """One timed gang, one OS process per rank: servers run the PS serve
     loop, clients run T rounds of {pull, push, wait} and report their
     round-loop window; aggregate MB/s uses the union of the client
@@ -288,6 +339,8 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
         "size": size, "ring": _ring_bytes(size), "rounds": ROUNDS,
         "heartbeat": int(heartbeat),
     }
+    if throttle_mbs > 0:
+        spec["throttle_mbs"] = throttle_mbs
     if decomp_out is not None:
         # Causal-tracing leg: the framed FLAG_TIMING wire (generous
         # deadline — a spurious retry at bench scale would corrupt the
@@ -423,6 +476,28 @@ def _analyze_gang_trace(base: str) -> dict:
     }
 
 
+def _throttle_applies(server, mbs: float) -> None:
+    """The elastic sweep's member-capacity model: every grad apply
+    blocks this serving rank for shard_bytes/rate wall-seconds — each
+    member is a fixed-capacity resource, so aggregate throughput is a
+    function of *membership*, not of how the bench host time-slices N
+    processes over its cores.  The blocking sleep is deliberate: it
+    serializes this rank's service the way a truly compute-bound apply
+    would."""
+    inner = server._apply_for
+
+    def apply_for(codec):
+        fn = inner(codec)
+
+        def throttled(param, grad, state):
+            time.sleep(server.size * 4 / (mbs * 2**20))
+            return fn(param, grad, state)
+
+        return throttled
+
+    server._apply_for = apply_for
+
+
 def _gang_child() -> None:
     """One rank of the process gang (--gang-child): a server runs the
     serve loop to completion; a client times its round loop and writes
@@ -503,6 +578,8 @@ def _gang_child() -> None:
                                 tags.PARAM_PUSH_ACK})))
         server = ParamServer(rank, cranks, ep, rule="add",
                              ft=server_ft, controller_rank=ctl_rank)
+        if spec.get("throttle_mbs"):
+            _throttle_applies(server, float(spec["throttle_mbs"]))
         server.start()
         result = {
             "role": "server", "grads_applied": server.grads_applied,
@@ -923,6 +1000,10 @@ def main():
         # *reply latency*, not the byte volume): rebalance off, then on.
         results.append(bench_shm("none", skew_rebalance=False))
         results.append(bench_shm("none", skew_rebalance=True))
+    if ELASTIC_SWEEP and MODE in ("shm", "both"):
+        # The shrink/grow sweep: capacity at each size of a 1 -> 2 -> 1
+        # membership walk; rows never join the codec=none gate.
+        results.extend(bench_elastic())
     for r in results:
         print(json.dumps(r))
     if BASELINE > 0:

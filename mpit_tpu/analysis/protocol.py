@@ -466,6 +466,69 @@ def _walk_el(fn: ast.AST):
         yield from _walk_el(child)
 
 
+#: the only calls a SIGTERM handler body may make: a pipe poke
+#: (os.write on a pre-opened wake fd).  Everything else — locks,
+#: allocation, transport sends, clock reads, logging — can deadlock or
+#: corrupt, because the handler interrupts arbitrary bytecode (possibly
+#: while the very lock it wants is held).
+_SIGTERM_ALLOWED_CALLS = {"write"}
+
+
+def _sigterm_handler_names(tree) -> "set[str]":
+    """Names of functions registered as SIGTERM handlers anywhere in the
+    module: ``signal.signal(signal.SIGTERM, fn)`` with ``fn`` a bare
+    name or an attribute (``obj.method`` registers ``method``)."""
+    names: set = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and callee_name(node) == "signal"
+                and len(node.args) >= 2):
+            continue
+        sig = node.args[0]
+        signame = (sig.attr if isinstance(sig, ast.Attribute)
+                   else sig.id if isinstance(sig, ast.Name) else "")
+        if signame != "SIGTERM":
+            continue
+        handler = node.args[1]
+        if isinstance(handler, ast.Name):
+            names.add(handler.id)
+        elif isinstance(handler, ast.Attribute):
+            names.add(handler.attr)
+    return names
+
+
+def _check_signal_handler_discipline(files: List[SourceFile]
+                                     ) -> List[Finding]:
+    """MT-P204: a SIGTERM handler may only set flags / write a pipe.
+    The preemption contract (ft/elastic.py, PROTOCOL.md §9.3) delivers
+    SIGTERM mid-bytecode — a handler that takes a lock the interrupted
+    frame holds deadlocks the rank exactly when it must checkpoint and
+    drain; allocation and transport calls are the same hazard wearing
+    different costumes.  Checked tree-wide: the hazard does not care
+    which directory the handler lives in."""
+    findings: List[Finding] = []
+    for src in files:
+        handlers = _sigterm_handler_names(src.tree)
+        if not handlers:
+            continue
+        for qual, fn in iter_functions(src.tree):
+            if qual.rsplit(".", 1)[-1] not in handlers:
+                continue
+            for node in _walk_el(fn):  # shallow: nested defs run later
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = callee_name(node)
+                if callee in _SIGTERM_ALLOWED_CALLS:
+                    continue
+                findings.append(src.finding(
+                    "MT-P204", node.lineno,
+                    f"{qual} is a SIGTERM handler but calls {callee}() — "
+                    "handlers interrupt arbitrary bytecode, so they may "
+                    "only set flags or os.write a wake pipe; do the real "
+                    "work (checkpoint, drain, report) from the serving "
+                    "loop's next poll"))
+    return findings
+
+
 def _check_spec_drift(files: List[SourceFile]) -> List[Finding]:
     findings: List[Finding] = []
     for src in files:
@@ -539,5 +602,6 @@ def check(files: List[SourceFile]) -> List[Finding]:
         findings += _check_tag_registration(tag_lines, pairs, files)
     findings += _check_deadline_discipline(files)
     findings += _check_event_loop_discipline(files)
+    findings += _check_signal_handler_discipline(files)
     findings += _check_spec_drift(files)
     return findings

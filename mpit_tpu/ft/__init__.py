@@ -25,8 +25,15 @@ existing layers:
 
 from mpit_tpu.ft.config import FTConfig
 from mpit_tpu.ft.dedup import DUP, FRESH, STALE, DedupTable
-from mpit_tpu.ft.faults import FaultPlan, FaultyTransport
-from mpit_tpu.ft.leases import ACTIVE, EVICTED, STOPPED, LeaseRegistry
+from mpit_tpu.ft.elastic import ElasticDirectory, PreemptionNotice
+from mpit_tpu.ft.faults import FaultPlan, FaultyTransport, inject_preemption
+from mpit_tpu.ft.leases import (
+    ACTIVE,
+    EVICTED,
+    RETIRED,
+    STOPPED,
+    LeaseRegistry,
+)
 from mpit_tpu.ft.retry import RetryExhausted, RetryPolicy
 from mpit_tpu.ft.wire import (
     ACK_TIMING_WORDS,
@@ -56,8 +63,9 @@ from mpit_tpu.ft.wire import (
 __all__ = [
     "FTConfig",
     "DedupTable", "FRESH", "DUP", "STALE",
-    "FaultPlan", "FaultyTransport",
-    "LeaseRegistry", "ACTIVE", "EVICTED", "STOPPED",
+    "FaultPlan", "FaultyTransport", "inject_preemption",
+    "PreemptionNotice", "ElasticDirectory",
+    "LeaseRegistry", "ACTIVE", "EVICTED", "STOPPED", "RETIRED",
     "RetryPolicy", "RetryExhausted",
     "HDR_BYTES", "HDR_STALE_BYTES",
     "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_READONLY", "FLAG_STALENESS",

@@ -34,6 +34,8 @@ The plan parses from a spec string (``MPIT_FT_FAULT_PLAN``), e.g.::
 from __future__ import annotations
 
 import os
+import signal as _signal
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -42,6 +44,34 @@ from mpit_tpu.ft.retry import _splitmix64
 from mpit_tpu.obs import metrics as _obs
 
 ENV = "MPIT_FT_FAULT_PLAN"
+
+
+def inject_preemption(pid: int, grace_s: float, poll_s: float = 0.05,
+                      escalate: bool = True) -> str:
+    """The process-level preemption arm: SIGTERM now, SIGKILL after the
+    grace window if the process is still alive — exactly a cloud spot
+    reclaim, and the counterpart of the supervisor's SIGKILL chaos hook
+    (a kill is instant death; a preemption is a *notice*).  Returns
+    ``"term"`` when the victim exited inside its grace window (the
+    graceful path: checkpoint-on-notice and/or a controller drain
+    finished in time) and ``"kill"`` when it had to be escalated (the
+    replay-from-checkpoint path).  ``escalate=False`` sends only the
+    notice — for harnesses that own the escalation themselves."""
+    os.kill(pid, _signal.SIGTERM)
+    if not escalate:
+        return "term"
+    deadline = _time.monotonic() + max(grace_s, 0.0)
+    while _time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return "term"
+        _time.sleep(poll_s)
+    try:
+        os.kill(pid, _signal.SIGKILL)
+    except ProcessLookupError:
+        return "term"
+    return "kill"
 
 PASS = "pass"
 DROP = "drop"

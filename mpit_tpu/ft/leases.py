@@ -8,10 +8,20 @@ client:
 
     ACTIVE --lease expiry--> EVICTED --INIT v3 (epoch+1)--> ACTIVE
     ACTIVE --STOP----------> STOPPED
+    ACTIVE --RETIRE--------> RETIRED   (elastic scale-down: a goodbye)
 
 Service loops pass ``registry.gone(crank)`` as their recv ``abort``
 predicate, so eviction unblocks them at the next probe poll; the stop
-condition becomes "every client STOPPED or EVICTED".  A lease is only
+condition becomes "every client STOPPED or EVICTED".
+
+Elasticity (mpit_tpu.ft.elastic / mpit_tpu.shardctl) adds two moves:
+``admit`` registers a rank that was not part of the launch-time set (a
+late-joining client, a controller-spawned server), and ``retire`` marks
+a member that left *on purpose* after a drain.  RETIRED is terminal
+like STOPPED but semantically distinct from EVICTED: a retired rank's
+silence is expected — ``expired()`` never reports it, so the controller
+never fails over a cleanly-drained server's (empty) shard set, and the
+flight recorder never writes a postmortem for a goodbye.  A lease is only
 armed for clients that *promised* heartbeats in their INIT v3 flags —
 arming it for a legacy (v1/v2) client would evict every pre-FT worker
 under a server with a TTL configured.
@@ -28,6 +38,7 @@ from typing import Callable, Dict, List, Optional
 ACTIVE = "active"
 EVICTED = "evicted"
 STOPPED = "stopped"
+RETIRED = "retired"
 
 
 class LeaseRegistry:
@@ -91,6 +102,21 @@ class LeaseRegistry:
         self._state[crank] = STOPPED
         self._expiry[crank] = None
 
+    def retire(self, crank: int) -> None:
+        """A clean, drained departure (elastic scale-down).  Unlike
+        eviction, retirement is never reported by ``expired()`` again —
+        retiring-then-silent is the expected shape, not a death."""
+        self._state[crank] = RETIRED
+        self._expiry[crank] = None
+
+    def admit(self, crank: int, epoch: int = 0) -> None:
+        """Register a rank that joined after construction (late client
+        admission / controller-spawned server).  Idempotent for known
+        ranks except that it re-activates them."""
+        self._state[crank] = ACTIVE
+        self._epoch.setdefault(crank, epoch)
+        self._expiry.setdefault(crank, None)
+
     def rejoin(self, crank: int, epoch: int) -> None:
         """A new incarnation re-announced: back to ACTIVE under its new
         epoch (the lease re-arms when the INIT flags promise beats)."""
@@ -102,6 +128,10 @@ class LeaseRegistry:
 
     def epoch(self, crank: int) -> int:
         return self._epoch.get(crank, 0)
+
+    def armed(self, crank: int) -> bool:
+        """True once the expiry clock started (first renew seen)."""
+        return self._expiry.get(crank) is not None
 
     def state(self, crank: int) -> str:
         return self._state.get(crank, ACTIVE)

@@ -9,6 +9,14 @@ path) and keeps serving the surviving clients' retried ops.  The rest of
 the gang never exits — client deadlines/retry and server leases cover
 the gap while the replacement comes up.
 
+Membership is **dynamic** (docs/PROTOCOL.md §9): the supervised set
+starts as ``initial_ranks`` (default: every rank) and changes mid-run
+through the elastic mailbox (:class:`mpit_tpu.ft.elastic
+.ElasticDirectory`) — a controller-requested spawn joins the set *and
+the restart budget* exactly like a launch-time member, and a rank the
+controller marked retired leaves the budget: its exit is a goodbye,
+never a crash to respawn (the respawn-of-retired flake this replaces).
+
 Restart mechanics per rank:
 
 - the replacement runs with ``MPIT_FT_EPOCH=<restart #>`` and
@@ -22,8 +30,12 @@ Restart mechanics per rank:
 
 ``chaos_kill_rank``/``chaos_kill_after_s`` are the process-level arm of
 the fault-injection harness (ft/faults.py is the message-level arm): the
-soak test SIGKILLs a live worker mid-run through the supervisor itself,
-so the kill lands at a reproducible point in the supervision loop.
+soak test signals a live rank mid-run through the supervisor itself, so
+the fault lands at a reproducible point in the supervision loop.
+``chaos_signal=SIGTERM`` with ``chaos_grace_s`` turns the instant death
+into a spot-style preemption: notice first, SIGKILL only if the rank is
+still alive when the grace window closes (ft/faults.py
+``inject_preemption`` is the same arm for external harnesses).
 """
 
 from __future__ import annotations
@@ -33,7 +45,7 @@ import signal
 import tempfile
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterable, Optional
 
 from mpit_tpu.utils.logging import get_logger
 
@@ -56,11 +68,16 @@ def supervise_gang(
     server_ranks: Optional[list] = None,
     chaos_kill_rank: Optional[int] = None,
     chaos_kill_after_s: float = 0.0,
+    chaos_signal: int = signal.SIGKILL,
+    chaos_grace_s: float = 0.0,
+    initial_ranks: Optional[Iterable[int]] = None,
+    elastic_dir: Optional[Any] = None,
 ) -> Dict[int, Dict[str, Any]]:
     """Run a gang to completion, restarting dead ranks under ``policy``.
 
     Same result contract as ``launch_gang``: rank -> result dict.  A
-    rank's *final* incarnation must exit 0 and write its result file.
+    rank's *final* incarnation must exit 0 and write its result file —
+    except retired ranks, whose goodbye needs no report.
     """
     from mpit_tpu.train.gang import spawn_rank
     from mpit_tpu.utils.config import Config
@@ -76,9 +93,12 @@ def supervise_gang(
     procs: Dict[int, Any] = {}
     logfiles: Dict[int, str] = {}
     resultfiles: Dict[int, str] = {}
-    restarts = {r: 0 for r in range(size)}
+    members = set(initial_ranks if initial_ranks is not None
+                  else range(size))
+    retired: set = set()
+    restarts = {r: 0 for r in members}
     done: Dict[int, int] = {}  # rank -> exit code 0
-    for rank in range(size):
+    for rank in sorted(members):
         procs[rank], logfiles[rank], resultfiles[rank] = spawn_rank(
             child_module, cfg, rank, size, logdir,
             extra_env=(env_overrides or {}).get(rank),
@@ -88,6 +108,7 @@ def supervise_gang(
         if chaos_kill_rank is not None else None
     )
     chaos_done = False
+    chaos_escalate_at: Optional[float] = None
     deadline = time.monotonic() + timeout
 
     def _teardown(reason: str) -> None:
@@ -114,30 +135,73 @@ def supervise_gang(
             merged = merged.merged(resume=True)
         return merged
 
-    while len(done) < size:
+    def _poll_elastic() -> None:
+        """Membership changes from the controller's mailbox: spawns
+        join the supervised set (and restart budget); retirement marks
+        strip a rank from the budget before — or after — its exit."""
+        if elastic_dir is None:
+            return
+        for rank, extra in elastic_dir.consume_spawns():
+            if rank in members and rank not in done:
+                log.warning("spawn request for live rank %d ignored", rank)
+                continue
+            log.info("elastic: spawning rank %d on controller request", rank)
+            members.add(rank)
+            done.pop(rank, None)
+            retired.discard(rank)
+            restarts.setdefault(rank, 0)
+            env = dict((env_overrides or {}).get(rank, {}))
+            env.update(extra or {})
+            # A mid-run join must skip the startup rendezvous.
+            procs[rank], logfiles[rank], resultfiles[rank] = spawn_rank(
+                child_module, cfg.merged(gang_barrier=False), rank, size,
+                logdir, extra_env=env,
+            )
+        for rank in elastic_dir.retired():
+            if rank in members and rank not in retired:
+                log.info("elastic: rank %d retired — leaving the restart "
+                         "budget", rank)
+                retired.add(rank)
+
+    while len(done) < len(members):
         if time.monotonic() > deadline:
             _teardown(f"supervised gang timed out after {timeout:.0f}s")
-        if chaos_at is not None and not chaos_done and time.monotonic() >= chaos_at:
+        _poll_elastic()
+        now = time.monotonic()
+        if chaos_at is not None and not chaos_done and now >= chaos_at:
             victim = procs[chaos_kill_rank]
             if victim.poll() is not None:
-                # A chaos kill that cannot land is a mis-tuned soak, and
+                # A chaos fault that cannot land is a mis-tuned soak, and
                 # letting it pass silently would fake the coverage.
                 _teardown(
-                    f"chaos kill scheduled for rank {chaos_kill_rank} but "
+                    f"chaos fault scheduled for rank {chaos_kill_rank} but "
                     "it already exited — lower chaos_kill_after_s or "
                     "lengthen the run"
                 )
-            log.warning("chaos: SIGKILL rank %d (pid %d)",
-                        chaos_kill_rank, victim.pid)
-            os.kill(victim.pid, signal.SIGKILL)
+            log.warning("chaos: signal %d -> rank %d (pid %d)",
+                        int(chaos_signal), chaos_kill_rank, victim.pid)
+            os.kill(victim.pid, chaos_signal)
             chaos_done = True
-        for rank, proc in procs.items():
+            if chaos_signal == signal.SIGTERM and chaos_grace_s > 0:
+                chaos_escalate_at = now + chaos_grace_s
+        if chaos_escalate_at is not None and now >= chaos_escalate_at:
+            victim = procs[chaos_kill_rank]
+            if victim.poll() is None:
+                log.warning(
+                    "chaos: grace window (%.1fs) closed — SIGKILL rank %d",
+                    chaos_grace_s, chaos_kill_rank)
+                os.kill(victim.pid, signal.SIGKILL)
+            chaos_escalate_at = None
+        for rank, proc in list(procs.items()):
             if rank in done:
                 continue
             code = proc.poll()
             if code is None:
                 continue
-            if code == 0:
+            if code == 0 or rank in retired:
+                # A retired rank's exit is a goodbye whatever its code
+                # (a preemption may SIGKILL it right after the drain):
+                # never respawned, never counted as a failure.
                 done[rank] = 0
                 continue
             if restarts[rank] >= policy.max_restarts:
@@ -172,14 +236,18 @@ def supervise_gang(
     import json
 
     results: Dict[int, Dict[str, Any]] = {}
-    for rank in range(size):
+    for rank in sorted(members):
         with open(logfiles[rank]) as fh:
             for line in fh:
                 print(line.rstrip("\n"))
         if os.path.exists(resultfiles[rank]):
             with open(resultfiles[rank]) as fh:
                 results[rank] = json.load(fh)
-    missing = [r for r in range(size) if r not in results]
+        elif rank in retired:
+            # A rank escalated to SIGKILL mid-exit wrote no report; its
+            # drain already completed, so a synthetic one is honest.
+            results[rank] = {"role": "server", "retired": True}
+    missing = [r for r in sorted(members) if r not in results]
     if missing:
         raise RuntimeError(
             f"ranks {missing} exited 0 but reported no result (logs: {logdir})"

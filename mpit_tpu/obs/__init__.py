@@ -84,6 +84,7 @@ from mpit_tpu.obs.spans import (
 )
 from mpit_tpu.obs.statusd import StatusServer
 from mpit_tpu.obs.statusd import maybe_start as maybe_start_statusd
+from mpit_tpu.obs.statusd import register_action as register_status_action
 from mpit_tpu.obs.statusd import register_provider as register_status_provider
 from mpit_tpu.obs.timers import PhaseTimers, profiler_trace, trace_annotation
 from mpit_tpu.obs.trace import (
@@ -101,6 +102,7 @@ __all__ = [
     "SpanRecorder", "OpSpan", "NULL_RECORDER", "NULL_SPAN", "get_recorder",
     "FlightRecorder", "NULL_FLIGHT", "get_flight", "validate_dump",
     "StatusServer", "maybe_start_statusd", "register_status_provider",
+    "register_status_action",
     "write_rank_trace", "merge_traces", "validate_trace",
     "maybe_write_rank_trace", "maybe_merge_rank_traces",
     "PhaseTimers", "trace_annotation", "profiler_trace",

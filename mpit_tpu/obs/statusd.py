@@ -52,6 +52,13 @@ ENV = _metrics.HTTP_ENV  # MPIT_OBS_HTTP
 _PROVIDERS: Dict[str, Callable[[], dict]] = {}
 _PROVIDERS_LOCK = threading.Lock()
 
+#: name -> callable(params dict) -> JSON-serializable dict.  Operator
+#: verbs served as ``GET /<name>?k=v`` — e.g. the shard controller's
+#: ``/scale`` route.  Handlers run on the HTTP thread, so they must
+#: only *enqueue* work (a thread-safe deque the role's own loop
+#: drains), never touch the cooperative scheduler.
+_ACTIONS: Dict[str, Callable[[Dict[str, str]], dict]] = {}
+
 
 def register_provider(name: str, fn: Callable[[], dict]) -> None:
     """Attach a status section (``/status`` key ``name``).  Re-registering
@@ -60,10 +67,25 @@ def register_provider(name: str, fn: Callable[[], dict]) -> None:
         _PROVIDERS[name] = fn
 
 
+def register_action(name: str, fn: Callable[[Dict[str, str]], dict]) -> None:
+    """Attach an operator verb at ``GET /<name>`` (query params become
+    the handler's dict).  Same replace-on-re-register rule as
+    providers."""
+    with _PROVIDERS_LOCK:
+        _ACTIONS[name] = fn
+
+
 def clear_providers() -> None:
-    """Drop every registered provider (tests; via obs.configure)."""
+    """Drop every registered provider and action (tests; via
+    obs.configure)."""
     with _PROVIDERS_LOCK:
         _PROVIDERS.clear()
+        _ACTIONS.clear()
+
+
+def _action_for(route: str) -> "Optional[Callable[[Dict[str, str]], dict]]":
+    with _PROVIDERS_LOCK:
+        return _ACTIONS.get(route.lstrip("/"))
 
 
 def _provider_sections() -> Dict[str, object]:
@@ -110,8 +132,15 @@ class StatusServer:
                     elif route == "/trace":
                         self._reply(200, json.dumps(outer.trace()).encode(),
                                     "application/json")
+                    elif (action := _action_for(route)) is not None:
+                        from urllib.parse import parse_qsl, urlsplit
+
+                        params = dict(parse_qsl(urlsplit(self.path).query))
+                        self._reply(200, json.dumps(action(params)).encode(),
+                                    "application/json")
                     else:
-                        self._reply(404, b"routes: /metrics /status /trace\n",
+                        self._reply(404, b"routes: /metrics /status /trace"
+                                    b" (+ registered actions)\n",
                                     "text/plain")
                 except Exception as exc:  # noqa: BLE001 — see _provider_sections
                     self._reply(500, repr(exc).encode(), "text/plain")

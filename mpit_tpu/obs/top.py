@@ -172,6 +172,9 @@ def _rank_row(rank: int, sample: Optional[dict],
         "shards": int(metric_sum(m, "mpit_shardctl_owned_shards")),
         "shard_busy_s": metric_sum(m, "mpit_shardctl_shard_busy_seconds_sum"),
         "map_version": int(metric_sum(m, "mpit_shardctl_map_version")),
+        # Elastic membership (PROTOCOL.md §9): the controller rank
+        # publishes the live server count; everyone else reads 0.
+        "gang_size": int(metric_sum(m, "mpit_gang_size", role="server")),
         "inflight": len(status.get("inflight_ops") or []),
     }
     if prev is not None and dt and dt > 0:
@@ -184,7 +187,7 @@ def _rank_row(rank: int, sample: Optional[dict],
 
 _COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "sendq", "conns",
             "busy", "stale", "retry", "evict", "shards", "busy_s", "mapv",
-            "infl")
+            "gang", "infl")
 
 
 def render_table(rows: List[Dict[str, object]]) -> str:
@@ -207,6 +210,7 @@ def render_table(rows: List[Dict[str, object]]) -> str:
             str(row["shards"]) if row["shards"] else "-",
             f"{row['shard_busy_s']:.2f}" if row["shard_busy_s"] else "-",
             str(row["map_version"]) if row["map_version"] else "-",
+            str(row["gang_size"]) if row.get("gang_size") else "-",
             str(row["inflight"]),
         ]
 

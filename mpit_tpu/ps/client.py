@@ -115,6 +115,7 @@ class ParamClient:
         shard_map: "Optional[_shardmap.ShardMap]" = None,
         shardctl: bool = False,
         controller_rank: Optional[int] = None,
+        sc_shards_per_server: int = 1,
     ):
         self.rank = rank
         self.sranks = list(server_ranks)
@@ -132,6 +133,19 @@ class ParamClient:
         self._sc = bool(shardctl or shard_map is not None)
         self.smap = shard_map
         self.controller_rank = controller_rank
+        # Over-partitioning (§9.1): cut the vector into k shards per
+        # launch-time server so elasticity has units to move — a gang
+        # that cut one shard per server can widen only by whole-shard
+        # handoff, never by sharing.
+        self._sc_cut = max(int(sc_shards_per_server), 1)
+        #: servers this incarnation has announced itself to (INIT); a
+        #: map may route shards to ranks that joined after launch —
+        #: first contact greets them (the lazy INIT v4, §9.1).
+        self._sc_greeted: set = set()
+        self._sc_flags = 0
+        #: ranks that left on purpose (RETIRED broadcasts): dropped
+        #: from heartbeat and STOP fan-out — a goodbye needs no goodbye.
+        self._sc_retired: set = set()
         if self._sc and self.ft.op_deadline_s <= 0:
             raise ValueError(
                 "shardctl needs op deadlines + retry (FTConfig."
@@ -494,7 +508,8 @@ class ParamClient:
                    if self._timing
                    else header_frame(self.ft.epoch, self._hb_seq))
         self._m_hb.inc()
-        for srank in self.sranks:
+        targets = self._sc_beat_targets() if self._sc else self.sranks
+        for srank in targets:
             self.sched.spawn(
                 self._hb_send(payload, srank), name=f"heartbeat:{srank}"
             )
@@ -535,7 +550,8 @@ class ParamClient:
         Per-shard staging is keyed by shard_id — placement moves, the
         cut never does, so buffers survive any number of migrations."""
         if self.smap is None:
-            self.smap = _shardmap.ShardMap.initial(len(param), self.sranks)
+            owners = [s for s in self.sranks for _ in range(self._sc_cut)]
+            self.smap = _shardmap.ShardMap.initial(len(param), owners)
         if self.smap.plong != len(param):
             raise ValueError(
                 f"shard map covers {self.smap.plong} elements but the "
@@ -545,6 +561,8 @@ class ParamClient:
         flags = FLAG_FRAMED | _scwire.FLAG_SHARDCTL | (
             FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0
         )
+        self._sc_flags = flags
+        self._sc_greeted = set(self.sranks)
         for e in self.smap.entries:
             if self.codec.identity:
                 nbytes = e.shard.size * param.dtype.itemsize
@@ -607,8 +625,13 @@ class ParamClient:
                                           tags.MAP_UPDATE)
             while not self.transport.test(handle):
                 pass  # iprobe saw a fully-assembled message
-            _k, _sid, _peer, m = _scwire.parse_map_update(
+            kind, _sid, peer, m = _scwire.parse_map_update(
                 bytes(self.transport.payload(handle)))
+            if kind == _scwire.RETIRED:
+                # A goodbye, not a crash: drop the rank from beat/STOP
+                # fan-out.  Its shards already drained (the map carried
+                # here no longer routes anything to it).
+                self._sc_retired.add(peer)
             if self.smap is None or m.version > self.smap.version:
                 self.smap = m
                 self._m_mapver.set(m.version)
@@ -666,6 +689,11 @@ class ParamClient:
         last: Optional[BaseException] = None
         while self.live.io:
             owner = self.smap.owner(sid)
+            if owner not in self._sc_greeted:
+                # First contact with a scaled-up server (§9.1): announce
+                # this incarnation before the op — the lazy INIT v4 that
+                # makes late membership transparent to the op stream.
+                yield from self._sc_greet(owner)
             if wire is not None:
                 _scwire.pack_sc_header(wire, self.ft.epoch, seq,
                                        self.smap.version, sid)
@@ -755,6 +783,22 @@ class ParamClient:
                 self._sc_poll_map()
         span.end("aborted")
         return None
+
+    def _sc_greet(self, owner: int):
+        """Announce this client (INIT v4 with the current map) to a
+        server that joined after launch.  The server's listener
+        negotiates and spawns services before it sees our first op —
+        both tags are FIFO per channel, so ordering is the transport's."""
+        cinfo = _scwire.init_v4(self.codec.wire_id, self.ft.epoch,
+                                self._sc_flags, self.smap)
+        yield from aio_send(self.transport, cinfo, owner, tags.INIT,
+                            live=self.live, deadline=self._op_deadline())
+        self._sc_greeted.add(owner)
+
+    def _sc_beat_targets(self) -> "List[int]":
+        """Liveness fan-out under shardctl: everyone this incarnation
+        announced itself to, minus clean departures."""
+        return sorted(self._sc_greeted - self._sc_retired)
 
     def _sc_decode(self, body, out: np.ndarray) -> None:
         frame = np.frombuffer(bytes(body), np.uint8)
@@ -1067,8 +1111,16 @@ class ParamClient:
         if self._sc:
             # The global shardctl pump gives the same drain-then-stop
             # ordering; the controller counts client STOPs too — its
-            # exit condition mirrors the servers'.
-            stop_to = self.sranks + (
+            # exit condition mirrors the servers'.  Membership may have
+            # changed since launch: STOP every server this incarnation
+            # greeted plus every current owner (a scaled-up joiner waits
+            # for our STOP like any launch member), and never a retired
+            # rank — it already said goodbye and exited.
+            self._sc_poll_map()
+            owners = set(self.smap.owners()) if self.smap is not None else set()
+            stop_to = sorted(
+                (set(self._sc_greeted or self.sranks) | owners)
+                - self._sc_retired) + (
                 [self.controller_rank] if self.controller_rank is not None
                 else [])
             for dst in stop_to:

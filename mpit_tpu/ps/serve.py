@@ -80,7 +80,7 @@ from mpit_tpu.obs import (
 from mpit_tpu.ps import tags
 from mpit_tpu.ps.sharding import Shard
 from mpit_tpu.shardctl import shardmap as _shardmap
-from mpit_tpu.shardctl.wire import OK
+from mpit_tpu.shardctl.wire import GOODBYE, OK
 from mpit_tpu.utils.logging import get_logger
 
 #: reader reply header: int64 [epoch, seq, status, word]
@@ -189,6 +189,15 @@ class ReaderClient:
         self.versions: Dict[int, int] = {}
         self.monotone = True
         self.reads_done = 0
+        # Server retirement (§9.4): a GOODBYE reply re-routes this
+        # attach slot to the named successor instead of burning the
+        # retry budget against a disappearing rank.  ``_route`` maps
+        # the launch-time server to wherever its slot is served now;
+        # ``_attached`` tracks who has seen our INIT.
+        self._route: Dict[int, int] = {}
+        self._attached: set = set()
+        self._announce: Dict[int, Shard] = {}
+        self._flags = 0
         self._hb_last = 0.0
         self._hb_seq = 0
         self.metrics = registry_or_local()
@@ -196,6 +205,8 @@ class ReaderClient:
         self._flight = get_flight()
         self._m_busy = self.metrics.counter(
             "mpit_ps_busy_honored_total", rank=rank)
+        self._m_reroutes = self.metrics.counter(
+            "mpit_ps_reader_reroutes_total", rank=rank)
         self._m_retries = self.metrics.counter(
             "mpit_ft_retries_total", rank=rank)
         self._m_hb = self.metrics.counter(
@@ -250,7 +261,9 @@ class ReaderClient:
         self.shards = [e.shard for e in smap.entries]
         flags = FLAG_FRAMED | FLAG_READONLY | (
             FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0)
+        self._flags = flags
         for srank, shard in zip(self.sranks, self.shards):
+            self._announce[srank] = shard
             cinfo = init_v3(shard.offset, shard.size, self.codec.wire_id,
                             self.ft.epoch, flags)
             self.sched.spawn(
@@ -259,6 +272,7 @@ class ReaderClient:
                 name=f"send_init:{srank}",
             )
         self.wait()
+        self._attached = set(self.sranks)
         self._started = True
         self._hb_last = 0.0
 
@@ -292,7 +306,7 @@ class ReaderClient:
         self._hb_seq += 1
         payload = header_frame(self.ft.epoch, self._hb_seq)
         self._m_hb.inc()
-        for srank in self.sranks:
+        for srank in self._targets():
             self.sched.spawn(self._hb_send(payload, srank),
                              name=f"heartbeat:{srank}")
 
@@ -323,53 +337,67 @@ class ReaderClient:
         max_busy = 64 * self._retry.attempts
         last: Optional[BaseException] = None
         while self.live.io:
+            target = self._route.get(srank, srank)
             deadline = self._op_deadline()
             try:
                 span.mark("send")
-                yield from aio_send(self.transport, req, srank,
+                yield from aio_send(self.transport, req, target,
                                     tags.PARAM_REQ, live=self.live,
                                     deadline=deadline)
                 span.mark("recv")
                 got_busy_hint: Optional[int] = None
-                while got_busy_hint is None:
-                    if self._half_pair.pop(srank, None):
+                rerouted = False
+                while got_busy_hint is None and not rerouted:
+                    if self._half_pair.pop(target, None):
                         # A previous attempt died between an OK header
                         # and its body: the channel's next message is
                         # that orphaned body — consume it to stay in
                         # sync before parsing headers again.
                         stale = yield from aio_recv(
-                            self.transport, srank, tags.PARAM,
+                            self.transport, target, tags.PARAM,
                             live=self.live, deadline=deadline)
                         if stale is None:
                             span.end("aborted")
                             return None
                     raw = yield from aio_recv(
-                        self.transport, srank, tags.PARAM, live=self.live,
+                        self.transport, target, tags.PARAM, live=self.live,
                         deadline=deadline)
                     if raw is None:
                         span.end("aborted")
                         return None
                     epoch, aseq, status, word = parse_serve_header(raw)
                     if status == OK:
-                        self._half_pair[srank] = True
+                        self._half_pair[target] = True
                         body = yield from aio_recv(
-                            self.transport, srank, tags.PARAM,
+                            self.transport, target, tags.PARAM,
                             live=self.live, deadline=deadline)
                         if body is None:
                             span.end("aborted")
                             return None
-                        self._half_pair.pop(srank, None)
+                        self._half_pair.pop(target, None)
                         if epoch == self.ft.epoch and aseq == seq:
                             span.mark("decode")
                             self._decode(body, out)
-                            self._note_version(srank, word)
+                            self._note_version(target, word)
                             span.note(version=word)
                             span.end("ok")
                             return word
                         continue  # stale pair (earlier attempt): dropped
+                    if status == GOODBYE and epoch == self.ft.epoch \
+                            and aseq == seq:
+                        # Retirement (§9.4): re-attach at the announced
+                        # successor and re-issue the same request there —
+                        # a redirect, not a failure, so the retry budget
+                        # is untouched.
+                        yield from self._reroute(srank, target, int(word))
+                        span.mark("reroute")
+                        rerouted = True
+                        continue
                     if epoch == self.ft.epoch and aseq == seq:
                         got_busy_hint = max(int(word), 0)
                     # stale BUSY echoes drop on the unchanged deadline
+                if rerouted:
+                    continue  # re-issue against the successor
                 busy += 1
                 self._m_busy.inc()
                 span.mark("backoff")
@@ -409,6 +437,32 @@ class ReaderClient:
                     return None
         span.end("aborted")
         return None
+
+    def _reroute(self, srank: int, old: int, succ: int):
+        """Follow a GOODBYE to the named successor: record the route
+        and, on first contact, announce the same READ-ONLY posture for
+        the same shard (the successor's dispatcher attaches us lazily,
+        any time mid-run)."""
+        if succ < 0 or succ == old:
+            raise RetryExhausted(
+                f"server {old} retired without a usable successor "
+                f"({succ})", 0, None)
+        self._m_reroutes.inc()
+        self._route[srank] = succ
+        self.log.warning("server %d retiring: re-attaching its shard "
+                         "reads to server %d", old, succ)
+        if succ not in self._attached:
+            shard = self._announce[srank]
+            cinfo = init_v3(shard.offset, shard.size, self.codec.wire_id,
+                            self.ft.epoch, self._flags)
+            yield from aio_send(self.transport, cinfo, succ, tags.INIT,
+                                live=self.live,
+                                deadline=self._op_deadline())
+            self._attached.add(succ)
+
+    def _targets(self) -> "List[int]":
+        """The physical ranks currently serving this reader's slots."""
+        return sorted({self._route.get(s, s) for s in self.sranks})
 
     def _decode(self, body, out: np.ndarray) -> None:
         frame = np.frombuffer(bytes(body), np.uint8)
@@ -485,7 +539,9 @@ class ReaderClient:
         return dict(self.versions)
 
     def stop(self) -> None:
-        for srank in self.sranks:
+        # STOP goes to wherever each slot is served *now*: a retired
+        # server already counted us out when it said GOODBYE (§9.4).
+        for srank in self._targets():
             self._enqueue(
                 srank,
                 aio_send(self.transport, tags.EMPTY, srank, tags.STOP,

@@ -138,6 +138,17 @@ class ParamServer:
         reader_ranks: Optional[list] = None,  # serving tier (§8): READ-ONLY
         #                                       attachers, not protocol clients
         serve: Optional["_psserve.ServeConfig"] = None,
+        shardctl: bool = False,  # joiner mode (§9.1): a controller-spawned
+        #                          server enters an sc gang mid-run — no
+        #                          phase-1 INIT wait; clients greet lazily
+        #                          and shards arrive via ACQUIRE
+        admit_ranks: Optional[list] = None,  # late-join candidates (§9.6):
+        #                                      client ranks that may INIT
+        #                                      mid-run without being part of
+        #                                      the launch-time set
+        preempt: "Optional[Any]" = None,  # ft.elastic.PreemptionNotice —
+        #                                   checkpoint-on-notice + PREEMPT
+        #                                   report when it fires (§9.3)
     ):
         self.rank = rank
         self.cranks = list(client_ranks)
@@ -231,7 +242,21 @@ class ParamServer:
         self.controller_rank = controller_rank
         self.smap: Optional[ShardMap] = None
         self._slots: Dict[int, ShardSlot] = {}
-        self._sc = False
+        self._sc = bool(shardctl)
+        self._sc_join = bool(shardctl)  # spawned mid-run: no INIT phase
+        # Elastic membership (§9): late-join candidates, the preemption
+        # notice to poll, retirement posture (a clean goodbye, observable
+        # as `retired` after start() returns), and the serving-tier
+        # successor announced to readers once retiring.
+        self.admit_ranks = list(admit_ranks or [])
+        if set(self.admit_ranks) & set(self.cranks):
+            raise ValueError(
+                f"admit_ranks {sorted(set(self.admit_ranks) & set(self.cranks))}"
+                " overlap client_ranks — launch-time members need no admission")
+        self._preempt = preempt
+        self._preempt_handled = False
+        self.retired = False
+        self._serve_successor: Optional[int] = None
         self._sc_apply_cache: Dict[Tuple[str, int], Callable] = {}
         self._sc_last_report: Dict[int, Tuple[int, float]] = {}
         self._sc_beat_seq = 0
@@ -267,6 +292,9 @@ class ParamServer:
                                    rank=_r, direction="in")
         self._m_sc_adopt = _m.counter("mpit_shardctl_adoptions_total",
                                       rank=_r)
+        self._m_admits = _m.counter("mpit_ps_admits_total", rank=_r)
+        self._m_preempt = _m.counter("mpit_ft_preempt_notices_total",
+                                     rank=_r)
         self._m_sc_ver = _m.gauge("mpit_shardctl_map_version", rank=_r)
         self._m_sc_owned = _m.gauge("mpit_shardctl_owned_shards", rank=_r)
         # Flight recorder + live introspection (obs/flight, obs/statusd):
@@ -329,6 +357,8 @@ class ParamServer:
             "owned_shards": sorted(self._slots),
             "readers": int(self._m_readers.value),
             "busy_replies": int(self._m_busy.value),
+            "retired": self.retired,
+            "retiring_to": self._serve_successor,
             "serve_inflight_bytes": self._serve_inflight_bytes,
             "clients": {
                 str(c): {
@@ -534,9 +564,17 @@ class ParamServer:
             )
         self._sc = True
         self._sc_install_map(smap)
-        for e in smap.shards_of(self.rank):
-            if e.shard_id not in self._slots:
-                self._sc_make_slot(e.shard_id, e.shard)
+        # Slot creation is a *boot-time* act (the version-0 cut, filled
+        # by the seeder's pushes).  Any later map — a late client's
+        # stale v0 announce after migrations, a greeting that carries a
+        # newer map, anything a joiner sees — must never conjure a
+        # zeroed slot: mid-run slots only ever arrive through
+        # ACQUIRE/ADOPT with their real state (§9.1).
+        if (not self._sc_join and self.smap is not None
+                and self.smap.version == 0):
+            for e in smap.shards_of(self.rank):
+                if e.shard_id not in self._slots:
+                    self._sc_make_slot(e.shard_id, e.shard)
         self._framed[crank] = True
         self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
         # The 32-byte shard-addressed header has no version slot; the
@@ -951,6 +989,19 @@ class ParamServer:
                    if r in self._codecs and not self.leases.gone(r))
         self._m_readers.set(live)
 
+    def retire_serving(self, successor: int) -> None:
+        """Serving-tier retirement (§9.4): from now on every reader
+        request is answered ``GOODBYE`` carrying ``successor`` — the
+        reader re-attaches there instead of burning its retry budget
+        against a disappearing rank.  The redirected reader is marked
+        STOPPED here (it will never send this rank another frame), so
+        the stop protocol completes without it."""
+        if successor == self.rank:
+            raise ValueError("a retiring server cannot be its own successor")
+        self._serve_successor = int(successor)
+        self.log.info("serving tier retiring: readers redirected to %d",
+                      successor)
+
     def _dispatch_recv(self, crank: int, tag: int, out=None):
         """Receive a message the dispatcher's probe already saw (fully
         assembled, so this completes without waiting on the peer)."""
@@ -1063,6 +1114,21 @@ class ParamServer:
             return
         self.leases.renew(crank, epoch)
         gen = self._gen[crank]
+        if self._serve_successor is not None:
+            # Retiring (§9.4): a goodbye-with-successor, not a grant —
+            # and not a silent vanish that costs the reader its budget.
+            succ = self._serve_successor
+            span.note(successor=succ)
+            span.mark("send")
+            header = _psserve.serve_reply(epoch, seq, _scwire.GOODBYE, succ)
+            reply_live[crank] = True
+            self.sched.spawn(
+                self._serve_reply(crank, gen, span, header, None, 0,
+                                  reply_live),
+                name=f"serve_goodbye:{crank}")
+            self.leases.stop(crank)
+            self._update_reader_gauge()
+            return
         nbytes = (self.size * np.dtype(self.dtype).itemsize
                   if codec.identity else codec.wire_nbytes(self.size))
         # An idle rank always grants (a frame larger than the whole
@@ -1128,6 +1194,10 @@ class ParamServer:
             span.end("served")
         else:
             span.end("busy")
+        # A goodbye may have marked the last non-terminal rank STOPPED;
+        # re-check the stop condition now that the reply is on the wire.
+        if self.leases.all_done():
+            self.live.stop()
 
     def _recv_grad(self, crank: int, gen: int = 0):
         """Loop: receive gradient frame, decode+apply the shard rule in
@@ -1461,7 +1531,10 @@ class ParamServer:
                 yield from self._sc_acquire(sid, peer, smap)
             elif kind == _scwire.ADOPT:
                 yield from self._sc_adopt(sid, peer, smap)
-            else:
+            elif kind == _scwire.RETIRE:
+                yield from self._sc_retire(smap)
+                return
+            else:  # INSTALL / RETIRED broadcasts: adopt the newer map
                 self._sc_install_map(smap)
 
     def _sc_release(self, sid: int, dst: int, new_map: ShardMap):
@@ -1576,6 +1649,113 @@ class ParamServer:
         self.log.warning("adopted shard %d from dead server %d (map v%d)",
                          sid, dead, new_map.version)
         span.end("adopted")
+
+    def _sc_retire(self, new_map: ShardMap):
+        """The RETIRE handshake's server side (§9.2): the controller
+        drained every shard off this rank before sending the directive,
+        so holding any slot here is a protocol violation — fail loud
+        rather than silently drop state.  Echo DONE (shard -1) as the
+        goodbye receipt, then stop: start() returns normally and the
+        process exits 0 — retirement is distinguishable from a crash
+        by exit shape *and* by the controller's RETIRED lease state."""
+        span = self._spans.op("RETIRE", peer=self.controller_rank,
+                              side="server", rank=self.rank)
+        self._sc_install_map(new_map)
+        if self._slots:
+            span.end("exhausted")
+            raise RuntimeError(
+                f"RETIRE directive while still owning shards "
+                f"{sorted(self._slots)} — the controller must drain "
+                "before retiring (docs/PROTOCOL.md §9.2)")
+        span.mark("ack")
+        yield from aio_send(
+            self.transport,
+            _scwire.map_update(_scwire.DONE, -1, self.rank, self.smap),
+            self.controller_rank, tags.MAP_UPDATE, live=self.live,
+            deadline=deadline_at(_scmigrate.SC_DEADLINE_S))
+        self.retired = True
+        self.log.info("retired: drained, goodbye sent (map v%d)",
+                      self.smap.version)
+        span.end("retired")
+        self.live.stop()
+
+    def _admit_listener(self, crank: int):
+        """Perpetual late-join listener (§9.6): ``crank`` was *not* in
+        the launch-time client set, but is provisioned rank space that
+        may announce itself any time mid-run — INIT v3/v4 is the whole
+        admission handshake, exactly like a rejoin except the first
+        arrival also registers the rank with the lease/stop machinery.
+        Subsequent INITs from the same rank are ordinary rejoins."""
+        first = True
+        while self.live.on:
+            payload = yield from aio_recv(self.transport, crank, tags.INIT,
+                                          live=self.live,
+                                          abort=self._sc_live_abort())
+            if payload is None:
+                return
+            if first:
+                # Register before negotiating: a loud negotiation
+                # failure should name a known member, and the stop
+                # protocol must count this rank from its first frame.
+                self.cranks.append(crank)
+                self.leases.admit(crank)
+                self._gen.setdefault(crank, 0)
+                self._svc_live.setdefault(crank, 0)
+            codec = self._negotiate(crank, payload)
+            if first:
+                first = False
+                self._m_admits.inc()
+                self.log.info("admitted late client %d (epoch %d)",
+                              crank, self.leases.epoch(crank))
+            else:
+                self._m_rejoins.inc()
+            self._gen[crank] += 1
+            self.leases.rejoin(crank, self.leases.epoch(crank))
+            self.leases.arm(crank, self.leases.epoch(crank),
+                            heartbeats=self._hb.get(crank, False))
+            self._alloc_client(crank, codec)
+            while self._svc_live[crank] > 0:
+                yield EXEC
+            self._spawn_services(crank)
+
+    def _check_preemption(self) -> None:
+        """Checkpoint-on-notice (§9.3), called from the checkpoint
+        loop's safe point (between scheduler passes — no grad is
+        mid-apply).  One shot: stamped atomic publish of every owned
+        shard, then a PREEMPT report so the controller can decide
+        whether the grace window is worth a drain.  The handler itself
+        only set a flag (mtlint MT-P204); everything here runs on the
+        serving thread."""
+        notice = self._preempt
+        if notice is None or not notice.poll() or self._preempt_handled:
+            return
+        self._preempt_handled = True
+        self._m_preempt.inc()
+        self.log.warning(
+            "preemption notice: %.1fs grace — checkpointing %s now",
+            notice.grace_s,
+            f"shards {sorted(self._slots)}" if self._sc else "shard")
+        if self._ckpt_dir and (self.param is not None or self._slots):
+            self.save_state(self._ckpt_dir)
+            self._m_ckpts.inc()
+        self._flight.record("preemption", rank=self.rank,
+                            grace_s=notice.grace_s)
+        self._flight.dump("preemption", rank=self.rank)
+        if self._sc and self.controller_rank is not None \
+                and self.smap is not None:
+            self.sched.spawn(self._send_preempt_notice(notice.grace_ms),
+                             name="preempt_notice")
+
+    def _send_preempt_notice(self, grace_ms: int):
+        try:
+            yield from aio_send(
+                self.transport,
+                _scwire.map_update(_scwire.PREEMPT, grace_ms, self.rank,
+                                   self.smap),
+                self.controller_rank, tags.MAP_UPDATE, live=self.live,
+                deadline=deadline_at(_scmigrate.SC_DEADLINE_S))
+        except DeadlineExceeded:
+            pass  # controller gone too; the checkpoint already landed
 
     def _sc_beat(self):
         """Beat to the controller: liveness plus the per-shard load
@@ -1796,9 +1976,13 @@ class ParamServer:
         next_save = time.monotonic() + self._ckpt_interval
         while self.sched.queue:
             self.sched.ping_pass()
+            self._check_preemption()
             if time.monotonic() >= next_save:
-                self.save_state(self._ckpt_dir)
-                self._m_ckpts.inc()
+                # A joiner that has not acquired a shard yet (or a
+                # fully-drained rank awaiting RETIRE) has nothing to cut.
+                if self.param is not None or self._slots:
+                    self.save_state(self._ckpt_dir)
+                    self._m_ckpts.inc()
                 next_save = time.monotonic() + self._ckpt_interval
         if self.param is not None or self._slots:
             self.save_state(self._ckpt_dir)  # final state at stop
@@ -1846,8 +2030,50 @@ class ParamServer:
                           warn_unexpected=self._restored),
                 name=f"recv_param:{crank}.g{gen}")
 
+    def _drive(self) -> None:
+        """Run the service queue to completion through whichever loop
+        this server's posture needs (checkpoints and/or preemption
+        polling; plain wait otherwise)."""
+        if self._ckpt_dir:
+            self._serve_with_checkpoints()
+        elif self._preempt is not None:
+            while self.sched.queue:
+                self.sched.ping_pass()
+                self._check_preemption()
+            if self.sched.errors:
+                raise self.sched.errors.pop(0)
+        else:
+            self.sched.wait()
+
     def start(self) -> None:
         """Run the server to completion (returns after the stop protocol)."""
+        if self._sc_join:
+            # Joiner (§9.1): spawned into a live gang by the controller.
+            # No phase-1 rendezvous — nobody owes us an INIT.  Every
+            # client gets a stop listener now (STOPs fan out to every
+            # owner at gang end) and an admission-style INIT listener
+            # (clients greet lazily before their first op to us); shards
+            # arrive via ACQUIRE, beats start immediately so the
+            # controller's scale_up sees the lease arm.
+            if self.controller_rank is None:
+                raise ValueError("a joiner server needs controller_rank — "
+                                 "it exists only under a control plane")
+            for crank in self.cranks:
+                self.sched.spawn(self._svc(crank, 0, self._recv_stop),
+                                 name=f"recv_stop:{crank}.g0")
+                self.sched.spawn(self._init_listener(crank),
+                                 name=f"init_listener:{crank}")
+            for crank in self.admit_ranks:
+                self.sched.spawn(self._admit_listener(crank),
+                                 name=f"admit_listener:{crank}")
+            if self.ft.lease_ttl_s > 0:
+                self.sched.spawn(self._lease_reaper(), name="lease_reaper")
+            self.sched.spawn(self._sc_map_listener(), name="sc_map_listener")
+            self.sched.spawn(self._sc_beat(), name="sc_beat")
+            self._drive()
+            self.log.debug("stopped: %s",
+                           self.metrics.format_summary(prefix="mpit_"))
+            return
         # Phase 1: shard announcements from every client (skipped for
         # clients restored from an FT checkpoint — their negotiation is
         # already in hand and no fresh INIT is coming).
@@ -1890,15 +2116,15 @@ class ParamServer:
             for crank in self.cranks:
                 self.sched.spawn(self._init_listener(crank),
                                  name=f"init_listener:{crank}")
+        for crank in self.admit_ranks:
+            self.sched.spawn(self._admit_listener(crank),
+                             name=f"admit_listener:{crank}")
         if self.ft.lease_ttl_s > 0:
             self.sched.spawn(self._lease_reaper(), name="lease_reaper")
         if self._sc and self.controller_rank is not None:
             self.sched.spawn(self._sc_map_listener(), name="sc_map_listener")
             self.sched.spawn(self._sc_beat(), name="sc_beat")
-        if self._ckpt_dir:
-            self._serve_with_checkpoints()
-        else:
-            self.sched.wait()
+        self._drive()
         # End-of-run summary rendered straight from the registry — every
         # number here (and any new instrument a layer adds) shows up
         # without touching this line.

@@ -48,6 +48,9 @@ FLAG_SHARDCTL = 4
 OK = 0
 NACK_MAP = 1  # not the owner any more; body = my (newer) serialized map
 BUSY = 2  # owner, but the shard is frozen mid-migration; retry shortly
+GOODBYE = 3  # serving tier (§9.4): this server is retiring; the reply's
+#              word names the successor rank — re-attach there.  Never
+#              sent on the shardctl data path (drained shards NACK).
 
 #: MAP_UPDATE directive kinds (first word of the payload, then
 #: [shard_id, peer_rank], then the serialized map)
@@ -56,6 +59,13 @@ RELEASE = 1  # server: freeze shard_id, serve one SHARD_PULL from peer
 ACQUIRE = 2  # server: pull shard_id's state from peer, then own it
 ADOPT = 3  # server: restore shard_id from its checkpoint (peer is dead)
 DONE = 4  # server -> controller: directive completed
+RETIRE = 5  # controller -> server: your shards are drained — echo DONE
+#             (shard_id -1) and exit cleanly (goodbye, not a crash)
+RETIRED = 6  # controller -> clients/servers broadcast: rank ``peer``
+#              left the gang on purpose; drop it from stop/beat targets
+PREEMPT = 7  # server -> controller: preemption notice received —
+#              ``shard_id`` carries the grace window in milliseconds;
+#              the controller drains me if the window allows (§9.3)
 
 
 def pack_sc_header(buf: np.ndarray, epoch: int, seq: int,

@@ -42,7 +42,25 @@ def child_transport(cfg: Config, rank: int, size: int):
                 f"got {len(addrs)}"
             )
         dial_peers = None
-        if os.environ.get("MPIT_FT_REJOIN", "0") not in ("0", ""):
+        reconnect = None
+        if bool(cfg.get("elastic", False)):
+            # Elastic gangs (PROTOCOL.md §9): the mesh rendezvous must
+            # never wait on a spare slot that has not spawned.  Initial
+            # members dial only lower *initial* ranks; a
+            # controller-spawned joiner dials exactly the live set the
+            # controller stamped into its spawn request
+            # (MPIT_ELASTIC_DIAL) — a retired or dead rank would burn
+            # the whole connect deadline.  Later arrivals (spares, a
+            # rejoiner) come through the loop's persistent accept
+            # service, so reconnect mode is forced on.
+            np0 = int(cfg.get("elastic_np0", 0) or 0) or size
+            dial_env = os.environ.get("MPIT_ELASTIC_DIAL", "")
+            if dial_env:
+                dial_peers = [int(x) for x in dial_env.split(",") if x]
+            else:
+                dial_peers = list(range(min(rank, np0)))
+            reconnect = float(os.environ.get("MPIT_TCP_RECONNECT_S", "60"))
+        elif os.environ.get("MPIT_FT_REJOIN", "0") not in ("0", ""):
             # A supervisor-restarted worker joins a mid-run gang: only
             # its servers must be reachable — a sibling worker that
             # already finished and exited is not a failure (PS traffic
@@ -55,7 +73,8 @@ def child_transport(cfg: Config, rank: int, size: int):
             )
             if rank not in sranks:
                 dial_peers = [r for r in sranks if r < rank]
-        transport = TcpTransport(rank, size, addrs, dial_peers=dial_peers)
+        transport = TcpTransport(rank, size, addrs, dial_peers=dial_peers,
+                                 reconnect=reconnect)
     else:
         from mpit_tpu.comm.shm import ShmTransport
 

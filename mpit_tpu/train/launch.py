@@ -113,6 +113,24 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     serve_interval_s=0.05,
     serve_budget_mb=64.0,
     serve_budget_reads=0,
+    # Elastic gangs (mpit_tpu.ft.elastic; docs/PROTOCOL.md §9): --elastic
+    # composes shardctl + the supervisor into dynamic membership.
+    # elastic_spares reserves that many joiner-server rank slots beyond
+    # --np (membership has a provisioned rank-space ceiling; spares
+    # spawn only when the controller asks the supervisor through the
+    # scale mailbox — or an operator hits the controller's /scale
+    # route).  Servers install a SIGTERM preemption notice
+    # (checkpoint-on-notice + a PREEMPT report; elastic_grace_s is the
+    # window they announce), and the initial cut makes
+    # elastic_shards_per_server shards per launch server so scale
+    # events have units to move.  Implies shardctl; requires
+    # supervise >= 1, ft_op_deadline_s > 0 and server_ckpt_dir; forces
+    # the startup barrier off when spares > 0 (spare ranks are not
+    # running at launch).
+    elastic=False,
+    elastic_spares=1,
+    elastic_grace_s=5.0,
+    elastic_shards_per_server=2,
 )
 
 
@@ -242,6 +260,48 @@ def run_reader(rank: int, sranks: List[int], cfg: Config,
     }
 
 
+def _maybe_preemption(cfg: Config):
+    """A server's SIGTERM preemption notice under --elastic (installed
+    in the child's main thread — run_rank runs there); None otherwise.
+    The handler only sets a flag (mtlint MT-P204); checkpoint-on-notice
+    and the PREEMPT report run from the serving loop (§9.3)."""
+    if not bool(cfg.get("elastic", False)):
+        return None
+    from mpit_tpu.ft.elastic import PreemptionNotice
+
+    return PreemptionNotice.from_env(
+        default_grace_s=float(cfg.get("elastic_grace_s", 5.0))).install()
+
+
+def run_joiner_server(rank: int, cranks: List[int], cfg: Config,
+                      transport: Any, ctl_rank: Optional[int]
+                      ) -> Dict[str, Any]:
+    """One controller-spawned joiner server (--elastic spare slot)."""
+    log = get_logger("launch", rank)
+    ckpt_dir = str(cfg.get("server_ckpt_dir", "") or "")
+    server = ParamServer(
+        rank, cranks, transport, rule=server_rule_for(cfg),
+        dtype=cfg.get("dtype", "float32"),
+        ckpt_dir=ckpt_dir or None,
+        ckpt_interval=float(cfg.get("server_ckpt_interval", 30.0)),
+        codec=str(cfg.get("codec", "") or "") or None,
+        ft=ft_from_cfg(cfg),
+        controller_rank=ctl_rank,
+        shardctl=True,
+        preempt=_maybe_preemption(cfg),
+    )
+    log.info("joiner server for clients %s (controller %s)", cranks, ctl_rank)
+    server.start()
+    return {
+        "role": "server",
+        "joiner": True,
+        "retired": server.retired,
+        "grads_applied": server.grads_applied,
+        "params_served": server.params_served,
+        "ckpts_written": server.ckpts_written,
+    }
+
+
 def run_rank(
     rank: int,
     size: int,
@@ -262,7 +322,14 @@ def run_rank(
         trainer = MnistTrainer(cfg, pclient=None, data=data, rank=rank)
         return {"role": "local", **trainer.run()}
 
-    sc_on = bool(cfg.get("shardctl", False))
+    elastic_on = bool(cfg.get("elastic", False))
+    sc_on = bool(cfg.get("shardctl", False)) or elastic_on
+    # Under --elastic the transport spans the provisioned ceiling
+    # (np0 + spares); roles split over the initial membership np0 and
+    # ranks beyond it are joiner-server slots the controller may spawn.
+    np0 = int(cfg.get("elastic_np0", 0) or 0) if elastic_on else size
+    if elastic_on and not np0:
+        np0 = size
     ctl_rank: Optional[int] = None
     role_size = size
     n_readers = int(cfg.get("serve_readers", 0) or 0)
@@ -287,40 +354,97 @@ def run_rank(
         if str(cfg.get("tester", "none")) != "none":
             raise ValueError("shardctl and a tester rank are mutually "
                              "exclusive for now (both claim an edge rank)")
-        if size < 3:
+        if np0 < 3:
             raise ValueError("shardctl needs np >= 3 "
                              "(>=1 server + >=1 worker + the controller)")
         if float(cfg.get("ft_op_deadline_s", 0) or 0) <= 0:
             raise ValueError("shardctl needs --ft_op_deadline_s > 0: map "
                              "re-routing rides the FT retry machinery")
-        ctl_rank = size - 1
-        role_size = size - 1
+        ctl_rank = np0 - 1
+        role_size = np0 - 1
     sranks, cranks, tester_rank = assign_roles(
         role_size, cfg.get("master_freq", 2), cfg.get("tester", "none")
     )
     single_mode = str(cfg.opt).endswith("-single")
     if rank in reader_ranks:
         return run_reader(rank, sranks, cfg, transport)
+    if elastic_on and rank >= np0:
+        # A spare slot the controller asked the supervisor to spawn:
+        # a joiner server — no INIT rendezvous, shards arrive by
+        # ACQUIRE, clients greet lazily (docs/PROTOCOL.md §9.1).
+        return run_joiner_server(rank, cranks, cfg, transport, ctl_rank)
     if sc_on and rank == ctl_rank:
         from mpit_tpu.shardctl import RebalancePolicy, ShardController
 
+        spawner = None
+        spares: List[int] = []
+        if elastic_on:
+            from mpit_tpu.ft.elastic import ElasticDirectory
+
+            spares = list(range(np0, size))
+            mailbox = ElasticDirectory.from_env()
+            if mailbox is not None:
+                def spawner(r):
+                    # Stamp the spawn request with the live set so the
+                    # joiner's TCP rendezvous dials only reachable
+                    # peers (train/gang.py child_transport).
+                    live = sorted(
+                        set(ctl._live_servers())
+                        | {c for c in ctl.cranks if c not in ctl._stopped}
+                        | {ctl.rank})
+                    mailbox.request_spawn(r, {
+                        "MPIT_ELASTIC_DIAL":
+                            ",".join(str(x) for x in live if x < r)})
+
+                retire_mark = mailbox.mark_retired
+            else:
+                retire_mark = None
         ctl = ShardController(
             rank, transport, sranks, cranks,
             policy=RebalancePolicy(ratio=float(cfg.get("shardctl_ratio", 3.0))),
             lease_ttl_s=float(cfg.get("shardctl_lease_ttl_s", 0) or 0),
+            spawner=spawner,
+            spare_ranks=spares,
         )
+        if elastic_on and retire_mark is not None:
+            # The supervisor must learn a retirement before the rank's
+            # exit reaches its budget check — wrap scale_down to mark
+            # the mailbox first.
+            _scale_down = ctl.scale_down
+
+            def scale_down_marked(r):
+                retire_mark(r)
+                return _scale_down(r)
+
+            ctl.scale_down = scale_down_marked
         ctl.serve()
         return {
             "role": "controller",
             "map_version": getattr(ctl.smap, "version", None),
             "rebalances": int(ctl._m_rebal.value),
             "failovers": int(ctl._m_fail.value),
+            "membership_epoch": ctl.membership_epoch,
+            "elastic_events": {
+                "up": int(ctl._m_up.value),
+                "down": int(ctl._m_down.value),
+                "preempt": int(ctl._m_pre.value),
+            },
         }
     if rank == tester_rank:
         from mpit_tpu.train.tester import run_tester
 
         return {"role": "tester", **run_tester(rank, sranks, cfg, transport, data)}
+    import os as _os
+
+    rejoining = _os.environ.get("MPIT_FT_REJOIN", "0") not in ("0", "")
     ft = ft_from_cfg(cfg)
+    if elastic_on and rank in sranks and rejoining:
+        # A supervisor-restarted server in an elastic gang rejoins as a
+        # joiner: its shards already failed over to survivors (or are
+        # about to), and shard-oriented checkpoints have no
+        # server<rank>_latest alias to resume from.  The controller
+        # rebalances onto it once its beats arm (§9.1).
+        return run_joiner_server(rank, cranks, cfg, transport, ctl_rank)
     if rank in sranks:
         # The tester counts as a (pull-only) client: it announces shards and
         # participates in the stop protocol like any worker.
@@ -336,6 +460,7 @@ def run_rank(
             controller_rank=ctl_rank,
             reader_ranks=reader_ranks or None,
             serve=serve_cfg_for(cfg) if reader_ranks else None,
+            preempt=_maybe_preemption(cfg),
         )
         if bool(cfg.get("resume", False)):
             import pathlib
@@ -360,9 +485,6 @@ def run_rank(
     # client re-seeds (ps/server.py restore_state contract).  Same for a
     # supervisor-restarted worker rejoining mid-run (MPIT_FT_REJOIN): the
     # live servers hold the current center, and a re-seed would rewind it.
-    import os as _os
-
-    rejoining = _os.environ.get("MPIT_FT_REJOIN", "0") not in ("0", "")
     pclient = ParamClient(
         rank, sranks, transport,
         seed_servers=(rank == cranks[0])
@@ -371,6 +493,9 @@ def run_rank(
         ft=ft,
         shardctl=sc_on,
         controller_rank=ctl_rank,
+        sc_shards_per_server=(
+            int(cfg.get("elastic_shards_per_server", 2) or 1)
+            if elastic_on else 1),
     )
     trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
     log.info("worker with servers %s", sranks)
@@ -387,15 +512,20 @@ def expected_role(rank: int, size: int, cfg: Config) -> str:
     raises the real error)."""
     if size == 1:
         return "local"
-    sc_on = bool(cfg.get("shardctl", False))
-    if sc_on and rank == size - 1:
+    elastic_on = bool(cfg.get("elastic", False))
+    sc_on = bool(cfg.get("shardctl", False)) or elastic_on
+    np0 = (int(cfg.get("elastic_np0", 0) or 0) or size) if elastic_on \
+        else size
+    if elastic_on and rank >= np0:
+        return "server"  # spare joiner slot
+    if sc_on and rank == np0 - 1:
         return "controller"
     n_readers = int(cfg.get("serve_readers", 0) or 0)
     if n_readers and rank >= size - n_readers:
         return "reader"
     try:
         sranks, _cranks, tester_rank = assign_roles(
-            size - 1 if sc_on else size - n_readers,
+            np0 - 1 if sc_on else size - n_readers,
             int(cfg.get("master_freq", 2)),
             str(cfg.get("tester", "none")))
     except ValueError:
@@ -444,8 +574,13 @@ def device_env_overrides(cfg: Config, size: int) -> Dict[int, Dict[str, str]]:
         # the first client; every other rank is forced to CPU.  Multi-chip
         # hosts should pass per-rank visible-device env via launch_gang's
         # env_overrides instead.  Under shardctl the last rank is the
-        # controller (a pure host role, never the accelerator owner).
-        role_size = size - 1 if bool(cfg.get("shardctl", False)) else size
+        # controller (a pure host role, never the accelerator owner);
+        # under --elastic the split runs over the initial membership
+        # (spare joiner slots are host roles).
+        role_size = int(cfg.get("elastic_np0", 0) or 0) or size
+        role_size = role_size - 1 if (bool(cfg.get("shardctl", False))
+                                      or bool(cfg.get("elastic", False))) \
+            else role_size
         role_size -= int(cfg.get("serve_readers", 0) or 0)  # readers: host roles
         sranks, cranks, tester = assign_roles(
             role_size, int(cfg.get("master_freq", 2)),
@@ -468,6 +603,59 @@ def launch_processes(cfg: Config, timeout: float = 3600.0) -> Dict[int, Dict[str
             f"unknown optimizer {cfg.opt!r}; have {MnistTrainer.KNOWN_OPTS}"
         )
     restarts = int(cfg.get("supervise", 0))
+    if bool(cfg.get("elastic", False)):
+        # --elastic (docs/PROTOCOL.md §9): shardctl + supervisor + the
+        # scale mailbox, over a provisioned rank-space ceiling of
+        # np + elastic_spares.  Spare slots spawn only on controller
+        # request; membership changes never restart the gang.
+        import os
+        import tempfile as _tempfile
+
+        from mpit_tpu.ft.elastic import ENV_DIR, ENV_GRACE_S, ElasticDirectory
+        from mpit_tpu.ft.supervisor import RestartPolicy, supervise_gang
+
+        if restarts <= 0:
+            raise ValueError("--elastic needs --supervise >= 1: the "
+                             "supervisor is what spawns and retires ranks")
+        if not str(cfg.get("server_ckpt_dir", "") or ""):
+            raise ValueError("--elastic needs --server_ckpt_dir: "
+                             "checkpoint-on-notice and shard failover "
+                             "write there")
+        if float(cfg.get("ft_op_deadline_s", 0) or 0) <= 0:
+            raise ValueError("--elastic needs --ft_op_deadline_s > 0: "
+                             "membership changes ride the retry machinery")
+        np0 = int(cfg.np)
+        spares = max(int(cfg.get("elastic_spares", 1) or 0), 0)
+        total = np0 + spares
+        cfg = cfg.merged(np=total, elastic_np0=np0, shardctl=True)
+        if spares > 0:
+            cfg = cfg.merged(gang_barrier=False)
+        mailbox = ElasticDirectory(
+            _tempfile.mkdtemp(prefix="mpit_elastic_"))
+        env_overrides = device_env_overrides(cfg, total)
+        for r in range(total):
+            env_overrides.setdefault(r, {})
+            env_overrides[r][ENV_DIR] = str(mailbox.root)
+            env_overrides[r][ENV_GRACE_S] = str(
+                float(cfg.get("elastic_grace_s", 5.0)))
+            if str(cfg.get("transport", "shm")) == "tcp":
+                # Spare slots join (and rejoiners re-join) through the
+                # event loop's persistent accept service — every rank
+                # must agree on reconnect mode (it is part of the mesh
+                # handshake digest).
+                env_overrides[r].setdefault(
+                    "MPIT_TCP_RECONNECT_S",
+                    os.environ.get("MPIT_TCP_RECONNECT_S", "60"))
+        sranks, _cranks, _tester = assign_roles(
+            np0 - 1, int(cfg.get("master_freq", 2)), "none")
+        return supervise_gang(
+            "mpit_tpu.train.launch", cfg, timeout,
+            policy=RestartPolicy(max_restarts=restarts),
+            env_overrides=env_overrides,
+            server_ranks=sranks + list(range(np0, total)),
+            initial_ranks=range(np0),
+            elastic_dir=mailbox,
+        )
     if restarts > 0:
         from mpit_tpu.ft.supervisor import RestartPolicy, supervise_gang
 

@@ -1,0 +1,24 @@
+"""Seeded MT-P204 violations: a SIGTERM handler that does real work.
+
+Every call in the handler body below is a seeded finding: taking a lock
+(the interrupted frame may hold it), allocating, and a transport send.
+"""
+
+import signal
+import threading
+import time
+
+import numpy as np
+
+_lock = threading.Lock()
+transport = None
+
+
+def on_preempt(signum, frame):
+    _lock.acquire()  # seeded MT-P204: lock in a signal handler
+    staging = np.zeros(1024)  # seeded MT-P204: allocation
+    transport.send(staging, 0, 2)  # seeded MT-P204: blocking transport call
+    time.sleep(0.01)  # seeded MT-P204: blocking sleep
+
+
+signal.signal(signal.SIGTERM, on_preempt)

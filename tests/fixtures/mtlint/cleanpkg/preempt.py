@@ -1,0 +1,22 @@
+"""The compliant preemption-notice shape (MT-P204 must stay silent):
+the SIGTERM handler only sets flags and pokes a pre-opened wake pipe;
+timestamping and the checkpoint/drain work happen on the serving
+thread's next poll."""
+
+import os
+import signal
+
+
+class Notice:
+    def __init__(self, wake_fd: int = -1):
+        self.notified = False
+        self._wake_fd = wake_fd
+
+    def _on_sigterm(self, signum, frame):
+        self.notified = True
+        if self._wake_fd >= 0:
+            os.write(self._wake_fd, b"\x01")
+
+
+NOTICE = Notice()
+signal.signal(signal.SIGTERM, NOTICE._on_sigterm)

@@ -98,6 +98,17 @@ class TestSeededViolations:
             ("tcp.py", 9), ("tcp.py", 11), ("tcp.py", 16)}
         assert all("event-loop callback" in f.message for f in hits)
 
+    def test_signal_handler_blocking_detected(self, bad):
+        # MT-P204: every call in the seeded SIGTERM handler (lock,
+        # allocation, transport send, sleep) is a finding; the cleanpkg
+        # flags-and-pipe handler must stay silent (asserted by
+        # test_clean_fixture_is_silent).
+        hits = bad.get("MT-P204", [])
+        assert {(f.path, f.line) for f in hits} == {
+            ("preempt.py", 18), ("preempt.py", 19),
+            ("preempt.py", 20), ("preempt.py", 21)}
+        assert all("SIGTERM handler" in f.message for f in hits)
+
     def test_yield_under_lock_detected(self, bad):
         hits = bad.get("MT-C203", [])
         assert [(f.path, f.line) for f in hits] == [("locks.py", 31)]

@@ -1,0 +1,642 @@
+"""Elastic gangs (ISSUE 9 / docs/PROTOCOL.md §9) — controller-driven
+scale-up/down, graceful preemption, late admission, reader re-route.
+
+The acceptance invariants: membership changes are **bitwise
+transparent** (a run that grew, drained-shrank, and absorbed a
+preemption ends with exactly the params of a static run — dedup travels
+with the shards, so exactly-once holds across every owner change, even
+under deterministic drop/dup fault plans and the int8 error-feedback
+codec), **bounded** (drains complete or fail loudly; a retired rank
+exits as a goodbye), and **observable** (elastic events + gang-size
+gauges + membership epoch; retire-vs-crash is a first-class lease
+distinction — a retired rank's silence never triggers failover)."""
+
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpit_tpu.comm.local import LocalRouter
+from mpit_tpu.ft import (
+    RETIRED,
+    FaultPlan,
+    FTConfig,
+    FaultyTransport,
+    LeaseRegistry,
+    PreemptionNotice,
+)
+from mpit_tpu.ps import ParamClient, ParamServer, ReaderClient, tags
+from mpit_tpu.shardctl import ShardController
+from mpit_tpu.shardctl import migrate as scmigrate
+
+DATA_TAGS = frozenset({tags.GRAD, tags.PARAM_REQ, tags.PARAM_PUSH})
+
+FAST_FT = FTConfig(op_deadline_s=0.5, max_retries=10,
+                   backoff_base_s=0.005, backoff_cap_s=0.02)
+
+
+def join_all(threads, timeout=30):
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "role thread did not stop (hang)"
+
+
+# ---------------------------------------------------------------------------
+# lease semantics: retire vs crash
+
+
+class TestLeaseRetire:
+    def test_retired_is_terminal_and_never_expires(self):
+        now = [0.0]
+        reg = LeaseRegistry([0, 1], ttl_s=1.0, clock=lambda: now[0])
+        for r in (0, 1):
+            reg.arm(r, 0, heartbeats=True)
+            reg.renew(r, 0)
+        reg.retire(1)
+        assert reg.state(1) == RETIRED and reg.gone(1)
+        now[0] += 100.0
+        # only the crash (rank 0) reads expired; the goodbye never does
+        assert reg.expired() == [0]
+
+    def test_admit_registers_for_stop_protocol(self):
+        reg = LeaseRegistry([0])
+        reg.stop(0)
+        assert reg.all_done()
+        reg.admit(5)
+        assert not reg.all_done()
+        reg.stop(5)
+        assert reg.all_done()
+
+    def test_retired_counts_as_done(self):
+        reg = LeaseRegistry([0, 1])
+        reg.stop(0)
+        reg.retire(1)
+        assert reg.all_done()
+
+
+# ---------------------------------------------------------------------------
+# gang harness: servers + controller threads, spawner for joiners
+
+
+def launch_elastic(nservers, nclients, nspares=1, ckpt_dir=None, codec=None,
+                   client_plans=None, client_ft=FAST_FT, server_ft=FAST_FT,
+                   shards_per_server=2, grace_s=5.0, late_clients=0,
+                   ctl_kwargs=None):
+    """Elastic shardctl topology over the in-process router: rank space
+    is provisioned for spares and late clients up front (membership has
+    a rank-space ceiling), but spares spawn only via the controller's
+    spawner hook and late clients only when the test starts them."""
+    n = nservers + nclients + nspares + late_clients + 1
+    router = LocalRouter(n)
+    sranks = list(range(nservers))
+    cranks = list(range(nservers, nservers + nclients))
+    late_ranks = list(range(nservers + nclients,
+                            nservers + nclients + late_clients))
+    ctl_rank = n - 1
+    spares = list(range(nservers + nclients + late_clients, ctl_rank))
+    servers, threads, notices = {}, {}, {}
+
+    def make_server(r, joiner):
+        notices[r] = PreemptionNotice(grace_s=grace_s)
+        # Launch members know only the launch clients (late ranks are
+        # admission candidates); a joiner spawns after any admissions,
+        # so it treats the whole provisioned client space as members.
+        servers[r] = ParamServer(
+            r, cranks + late_ranks if joiner else list(cranks),
+            router.endpoint(r), rule="add",
+            ft=server_ft, controller_rank=ctl_rank, ckpt_dir=ckpt_dir,
+            ckpt_interval=1e9, shardctl=joiner, preempt=notices[r],
+            admit_ranks=late_ranks if not joiner else None)
+        threads[r] = threading.Thread(target=servers[r].start, daemon=True)
+        threads[r].start()
+
+    for r in sranks:
+        make_server(r, joiner=False)
+    ctl = ShardController(
+        ctl_rank, router.endpoint(ctl_rank), sranks, cranks + late_ranks,
+        spawner=lambda r: make_server(r, joiner=True), spare_ranks=spares,
+        **(ctl_kwargs or {}))
+    clients = []
+    for i, r in enumerate(cranks):
+        ep = router.endpoint(r)
+        plan = (client_plans or {}).get(i)
+        if plan is not None:
+            ep = FaultyTransport(ep, plan)
+        clients.append(ParamClient(
+            r, sranks, ep, seed_servers=(r == cranks[0]), codec=codec,
+            ft=client_ft, shardctl=True, controller_rank=ctl_rank,
+            sc_shards_per_server=shards_per_server))
+    return dict(router=router, servers=servers, threads=threads,
+                notices=notices, ctl=ctl, clients=clients, sranks=sranks,
+                cranks=cranks, late_ranks=late_ranks, spares=spares)
+
+
+def start_clients(clients, w0):
+    starters = []
+    for i, c in enumerate(clients):
+        p = w0.copy() if i == 0 else np.zeros_like(w0)
+        starters.append(threading.Thread(
+            target=c.start, args=(p, np.zeros_like(w0)), daemon=True))
+        starters[-1].start()
+    join_all(starters)
+
+
+def finish(gang):
+    clients, ctl = gang["clients"], gang["ctl"]
+    clients[0].async_recv_param()
+    clients[0].wait()
+    out = clients[0].param.copy()
+    for c in clients:
+        c.stop()
+    join_all(list(gang["threads"].values()))
+    ctl.pump()
+    assert ctl.done, "controller missed client STOPs"
+    return out
+
+
+def run_gang(w0, gtab, rounds, hook=None, **kw):
+    gang = launch_elastic(2, 2, **kw)
+    start_clients(gang["clients"], w0)
+    gang["ctl"].pump()
+    assert gang["ctl"].smap is not None
+    for r in range(rounds):
+        if hook is not None:
+            hook(r, gang)
+        for i, c in enumerate(gang["clients"]):
+            c.grad[:] = gtab[i, r]
+            c.async_send_grad()
+            c.wait()
+    out = finish(gang)
+    return out, gang
+
+
+def tables(size=64, rounds=8, nclients=2, seed=11):
+    rng = np.random.default_rng(seed)
+    w0 = rng.normal(size=size).astype(np.float32)
+    gtab = rng.normal(size=(nclients, rounds, size)).astype(np.float32)
+    return w0, gtab
+
+
+def wait_for(cond, what, timeout=20.0, tick=None):
+    t0 = time.monotonic()
+    while not cond():
+        if tick is not None:
+            tick()
+        assert time.monotonic() - t0 < timeout, what
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# scale events: bitwise transparency
+
+
+class TestScaleEvents:
+    def test_scale_down_drain_is_bitwise(self):
+        """Drain-and-retire a server mid-run: final params bitwise equal
+        to the static run; the retired rank exits cleanly as a goodbye,
+        not a crash, and clients drop it from their stop fan-out."""
+        w0, gtab = tables()
+        static, _ = run_gang(w0, gtab, 8)
+
+        def hook(r, gang):
+            if r == 4:
+                assert gang["ctl"].scale_down(0)
+                gang["threads"][0].join(10)
+                assert not gang["threads"][0].is_alive(), \
+                    "retired server did not exit"
+                assert gang["servers"][0].retired
+
+        drained, gang = run_gang(w0, gtab, 8, hook=hook)
+        np.testing.assert_array_equal(static, drained)
+        assert gang["ctl"].retired == {0}
+        assert gang["ctl"].leases.state(0) == RETIRED
+        assert all(0 in c._sc_retired for c in gang["clients"]), \
+            "clients never learned the retirement broadcast"
+        assert gang["servers"][1].owned_shards == [0, 1, 2, 3]
+
+    def test_scale_down_under_faults_and_int8_stays_bitwise(self):
+        """The acceptance matrix: drop/dup plans on client data tags plus
+        the int8 error-feedback codec, a drain mid-run — still bitwise
+        (the residual telescope and per-shard dedup survive the owner
+        changes)."""
+        w0, gtab = tables(size=4096)
+        static, _ = run_gang(w0, gtab, 8, codec="int8")
+
+        def hook(r, gang):
+            if r == 3:
+                assert gang["ctl"].scale_down(1)
+
+        plans = {i: FaultPlan(seed=i, drop_every=3, dup_every=4,
+                              tags=DATA_TAGS) for i in range(2)}
+        faulty, gang = run_gang(w0, gtab, 8, codec="int8", hook=hook,
+                                client_plans=plans)
+        np.testing.assert_array_equal(static, faulty)
+        assert sum(int(s.dup_ops) for s in gang["servers"].values()) > 0, \
+            "no duplicate was ever admitted — the plan never bit"
+        assert any(c.residual_norm() > 0 for c in gang["clients"])
+
+    def test_scale_up_widens_and_scale_down_shrinks(self):
+        """Grow onto a spawned joiner (shards migrate to it, clients
+        greet it lazily), then drain it again — bitwise, with membership
+        epoch and gauges tracking every change."""
+        w0, gtab = tables()
+        static, _ = run_gang(w0, gtab, 8)
+        seen = {}
+
+        def hook(r, gang):
+            ctl = gang["ctl"]
+            if r == 2:
+                new = ctl.scale_up()
+                seen["joiner"] = new
+                assert len(ctl.smap.shards_of(new)) >= 1, \
+                    "scale-up left the joiner shardless"
+            if r == 6:
+                assert ctl.scale_down(seen["joiner"])
+                gang["threads"][seen["joiner"]].join(10)
+                assert not gang["threads"][seen["joiner"]].is_alive()
+
+        grown, gang = run_gang(w0, gtab, 8, hook=hook)
+        np.testing.assert_array_equal(static, grown)
+        ctl = gang["ctl"]
+        assert ctl.membership_epoch == 2
+        assert int(ctl._m_up.value) == 1 and int(ctl._m_down.value) == 1
+        # the joiner was greeted by at least one client mid-run
+        assert any(seen["joiner"] in c._sc_greeted
+                   for c in gang["clients"])
+        assert int(ctl._m_gang_srv.value) == 2  # back to two live servers
+
+    def test_retired_rank_never_fails_over(self, tmp_path):
+        """Retire-vs-crash: after a drain-and-retire, the retired rank's
+        lease silence must NOT look like a death — no failover, no map
+        churn (the goodbye already moved everything)."""
+        now = [0.0]
+        w0, gtab = tables()
+
+        def hook(r, gang):
+            ctl = gang["ctl"]
+            now[0] += 1.0
+            if r == 3:
+                # Arm the lease with a real beat first, then retire.
+                wait_for(lambda: ctl.leases.armed(0), "no beat arrived",
+                         tick=ctl.pump)
+                assert ctl.scale_down(0)
+                version = ctl.smap.version
+                failovers = int(ctl._m_fail.value)
+                now[0] += 1000.0  # far past any TTL
+                ctl.check_leases()
+                assert int(ctl._m_fail.value) == failovers, \
+                    "a retired rank was failed over"
+                assert ctl.smap.version == version
+
+        out, gang = run_gang(
+            w0, gtab, 8, hook=hook, ckpt_dir=str(tmp_path),
+            ctl_kwargs=dict(lease_ttl_s=5.0, clock=lambda: now[0]))
+        static, _ = run_gang(w0, gtab, 8)
+        np.testing.assert_array_equal(static, out)
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption
+
+
+class TestPreemption:
+    def test_sigterm_handler_sets_flag_only(self):
+        """The real signal: SIGTERM to self sets the notice flag (the
+        handler's only act — MT-P204); grace accounting happens on the
+        observing thread."""
+        notice = PreemptionNotice(grace_s=2.0).install()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            wait_for(lambda: notice.notified, "handler never fired",
+                     timeout=5)
+            assert notice.poll()
+            assert 0.0 <= notice.grace_remaining_s() <= 2.0
+        finally:
+            notice.restore()
+
+    def test_preemption_notice_checkpoints_then_drains(self, tmp_path):
+        """Notice -> checkpoint-on-notice (fresh: covers every applied
+        grad) -> PREEMPT report -> controller drains gracefully ->
+        retire.  Bitwise vs static, and the checkpoint on disk is
+        stamped with the exact pre-notice apply count."""
+        w0, gtab = tables()
+        static, _ = run_gang(w0, gtab, 8)
+        state = {}
+
+        def hook(r, gang):
+            ctl = gang["ctl"]
+            if r == 4:
+                victim = gang["servers"][1]
+                applied_before = victim.grads_applied
+                gang["notices"][1]._notified = True  # the handler's act
+                wait_for(lambda: 1 in ctl.retired, "drain never happened",
+                         tick=ctl.pump)
+                gang["threads"][1].join(10)
+                assert not gang["threads"][1].is_alive()
+                state["applied"] = applied_before
+                assert victim.ckpts_written >= 1, \
+                    "checkpoint-on-notice never wrote"
+
+        out, gang = run_gang(w0, gtab, 8, hook=hook,
+                             ckpt_dir=str(tmp_path))
+        np.testing.assert_array_equal(static, out)
+        ctl = gang["ctl"]
+        assert int(ctl._m_pre.value) == 1 and int(ctl._m_down.value) == 1
+        assert gang["servers"][1].retired
+        # Checkpoint freshness: the per-shard snapshots on disk carry
+        # every apply the victim had done when the notice landed.
+        ckpt_applied = sum(
+            scmigrate.load_shard_state(str(tmp_path), sid).grads_applied
+            for sid in (2, 3))  # server 1's boot-cut shards
+        assert ckpt_applied >= state["applied"]
+
+    def test_stingy_grace_skips_drain(self, tmp_path):
+        """A notice under the drain threshold is recorded (events) but
+        NOT drained — covering it is failover's job (replay from the
+        checkpoint the notice just wrote)."""
+        w0, gtab = tables()
+
+        def hook(r, gang):
+            ctl = gang["ctl"]
+            if r == 4:
+                gang["notices"][1]._notified = True
+                wait_for(lambda: int(ctl._m_pre.value) == 1,
+                         "notice never reached the controller",
+                         tick=ctl.pump)
+                assert 1 not in ctl.retired
+
+        out, gang = run_gang(
+            w0, gtab, 8, hook=hook, ckpt_dir=str(tmp_path), grace_s=0.05,
+            ctl_kwargs=dict(preempt_drain_min_s=0.5))
+        # grace too small for a drain: the victim kept serving (this
+        # in-process harness never actually kills it), so the run is
+        # still bitwise and the victim is still live at the end.
+        static, _ = run_gang(w0, gtab, 8)
+        np.testing.assert_array_equal(static, out)
+        assert int(gang["ctl"]._m_down.value) == 0
+        assert gang["servers"][1].ckpts_written >= 1
+
+
+# ---------------------------------------------------------------------------
+# late-client admission
+
+
+class TestLateAdmission:
+    def test_late_client_joins_mid_run(self):
+        """A client outside the launch-time set announces mid-run
+        (INIT v4 through the admission listener), trains alongside the
+        original clients, and participates in the stop protocol — no
+        gang restart."""
+        w0, gtab = tables(rounds=6)
+        gang = launch_elastic(2, 2, late_clients=1)
+        start_clients(gang["clients"], w0)
+        gang["ctl"].pump()
+        late_rank = gang["late_ranks"][0]
+        extra = np.ones((3, len(w0)), np.float32) * 0.5
+        late = None
+        for r in range(6):
+            if r == 2:
+                late = ParamClient(
+                    late_rank, gang["sranks"],
+                    gang["router"].endpoint(late_rank), ft=FAST_FT,
+                    shardctl=True,
+                    controller_rank=gang["ctl"].rank,
+                    sc_shards_per_server=2)
+                t = threading.Thread(
+                    target=late.start,
+                    args=(np.zeros_like(w0), np.zeros_like(w0)),
+                    daemon=True)
+                t.start()
+                join_all([t])
+                gang["clients"].append(late)
+            for i, c in enumerate(gang["clients"]):
+                if c is late:
+                    grad = extra[min(r - 2, 2)] if r - 2 < 3 else None
+                    if r - 2 >= 3:
+                        continue
+                    c.grad[:] = grad
+                else:
+                    c.grad[:] = gtab[i, r]
+                c.async_send_grad()
+                c.wait()
+        out = finish(gang)
+        want = w0 + gtab[:, :6].sum(axis=(0, 1)) + extra.sum(axis=0)
+        np.testing.assert_allclose(out, want, rtol=1e-4)
+        admits = sum(int(s._m_admits.value)
+                     for s in gang["servers"].values())
+        assert admits == len(gang["servers"]), \
+            "every server should admit the late client exactly once"
+
+
+# ---------------------------------------------------------------------------
+# serving tier: reader re-route on retirement
+
+
+class TestReaderRetirement:
+    def test_goodbye_reroutes_reader_to_successor(self):
+        """Read-replica pair: both servers hold the full vector; the
+        reader attaches to server 0.  Retirement answers reads with
+        GOODBYE(successor=1); the reader re-attaches and keeps reading
+        — no RetryExhausted, retry budget untouched."""
+        n = 16
+        router = LocalRouter(5)  # 0,1 servers; 2,3 writers; 4 reader
+        ft = FAST_FT
+        servers = [
+            ParamServer(0, [2], router.endpoint(0), rule="add", ft=ft,
+                        reader_ranks=[4]),
+            ParamServer(1, [3], router.endpoint(1), rule="add", ft=ft,
+                        reader_ranks=[4]),
+        ]
+        threads = [threading.Thread(target=s.start, daemon=True)
+                   for s in servers]
+        for t in threads:
+            t.start()
+        w = np.arange(n, dtype=np.float32)
+        writers = [
+            ParamClient(2, [0], router.endpoint(2), seed_servers=True,
+                        ft=ft),
+            ParamClient(3, [1], router.endpoint(3), seed_servers=True,
+                        ft=ft),
+        ]
+        starters = []
+        for wr in writers:
+            starters.append(threading.Thread(
+                target=wr.start, args=(w.copy(), np.zeros_like(w)),
+                daemon=True))
+            starters[-1].start()
+        join_all(starters)
+        reader = ReaderClient(4, [0], router.endpoint(4), ft=ft)
+        mirror = np.zeros(n, np.float32)
+        reader.start(mirror)
+        reader.read_params()
+        np.testing.assert_array_equal(mirror, w)
+        # retire server 0's serving slot toward its replica
+        servers[0].retire_serving(successor=1)
+        mirror[:] = 0
+        reader.read_params()  # GOODBYE -> re-attach at 1 -> served
+        np.testing.assert_array_equal(mirror, w)
+        assert int(reader._m_reroutes.value) == 1
+        assert reader._route == {0: 1}
+        mirror[:] = 0
+        reader.read_params()  # subsequent reads go straight to 1
+        np.testing.assert_array_equal(mirror, w)
+        assert int(reader._m_reroutes.value) == 1
+        reader.stop()
+        for wr in writers:
+            wr.stop()
+        join_all(threads)
+
+
+# ---------------------------------------------------------------------------
+# operator-driven scaling: the statusd /scale route
+
+
+class TestScaleRoute:
+    def test_scale_route_queues_and_pump_executes(self):
+        """GET /scale?op=down&rank=0 on the controller's endpoint queues
+        the request (HTTP thread) and pump() executes it (control
+        thread) — the wiring an operator uses mid-run."""
+        from mpit_tpu.obs import statusd
+
+        w0, gtab = tables()
+        gang = launch_elastic(2, 2)
+        start_clients(gang["clients"], w0)
+        ctl = gang["ctl"]
+        ctl.pump()
+        server = statusd.StatusServer(0)  # ephemeral port
+        statusd.register_action("scale", ctl._scale_action)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(f"{base}/scale?op=down&rank=0",
+                                        timeout=5) as resp:
+                import json
+
+                body = json.loads(resp.read())
+            assert body["queued"] == {"op": "down", "rank": "0"}
+            with urllib.request.urlopen(f"{base}/scale?op=sideways",
+                                        timeout=5) as resp:
+                assert b"error" in resp.read()
+            for r in range(4):
+                for i, c in enumerate(gang["clients"]):
+                    c.grad[:] = gtab[i, r]
+                    c.async_send_grad()
+                    c.wait()
+                ctl.pump()
+            wait_for(lambda: 0 in ctl.retired, "queued scale-down never ran",
+                     tick=ctl.pump)
+            finish(gang)
+        finally:
+            statusd.clear_providers()
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# the fast chaos soak: >= 3 membership changes, bitwise, bounded
+
+
+class TestChaosSoak:
+    def test_soak_grow_shrink_preempt_is_bitwise(self, tmp_path):
+        """The §9 proof in miniature: mid-DOWNPOUR-shaped lockstep the
+        gang (a) grows onto a spawned joiner, (b) drain-shrinks an
+        original server, (c) absorbs a graceful preemption of another —
+        three membership changes, ending on a gang whose only server is
+        the mid-run joiner.  Final params bitwise-equal the static run
+        (exactly-once held across every owner change) and every stage
+        completed inside its bound (no hang)."""
+        w0, gtab = tables(rounds=10, seed=23)
+        static, _ = run_gang(w0, gtab, 10)
+        joiner = {}
+
+        def hook(r, gang):
+            ctl = gang["ctl"]
+            if r == 2:
+                joiner["rank"] = ctl.scale_up()
+            if r == 5:
+                assert ctl.scale_down(0)
+                gang["threads"][0].join(10)
+                assert not gang["threads"][0].is_alive()
+            if r == 8:
+                gang["notices"][1]._notified = True
+                wait_for(lambda: 1 in ctl.retired, "preempt drain hung",
+                         tick=ctl.pump)
+                gang["threads"][1].join(10)
+                assert not gang["threads"][1].is_alive()
+
+        out, gang = run_gang(w0, gtab, 10, hook=hook,
+                             ckpt_dir=str(tmp_path))
+        np.testing.assert_array_equal(static, out)
+        ctl = gang["ctl"]
+        events = {"up": int(ctl._m_up.value),
+                  "down": int(ctl._m_down.value),
+                  "preempt": int(ctl._m_pre.value)}
+        assert events == {"up": 1, "down": 2, "preempt": 1}
+        assert ctl.membership_epoch == 3
+        # the whole vector ended up on the joiner
+        assert gang["servers"][joiner["rank"]].owned_shards == [0, 1, 2, 3]
+        assert int(ctl._m_gang_srv.value) == 1
+
+
+# ---------------------------------------------------------------------------
+# the slow soak: real processes, launch --elastic, SIGTERM-grace chaos
+
+
+@pytest.mark.slow
+def test_launch_elastic_preemption_soak(tmp_path, monkeypatch):
+    """np=5 (2s/2c/1ctl) + 1 spare DOWNPOUR gang over TCP via
+    ``--elastic``: the supervisor SIGTERMs server rank 2 mid-run with a
+    grace window (spot-style preemption).  The rank checkpoints on
+    notice, reports PREEMPT, the controller drains it through live
+    migration, marks it retired in the scale mailbox (so the supervisor
+    never respawns it), and the run converges in the fault-free
+    envelope on the surviving membership."""
+    import socket
+
+    from mpit_tpu.train.launch import LAUNCH_DEFAULTS, launch_processes
+
+    socks = [socket.socket() for _ in range(6)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    addrs = ",".join(f"127.0.0.1:{s.getsockname()[1]}" for s in socks)
+    for s in socks:
+        s.close()
+    monkeypatch.setenv("MPIT_TCP_RECONNECT_S", "60")
+    cfg = LAUNCH_DEFAULTS.merged(
+        # epochs sized so the +90s preemption lands mid-training: on a
+        # 1-core box five children importing jax serialize to ~45s of
+        # boot before any role (or SIGTERM handler) exists, and
+        # training then runs ~0.15s/epoch.
+        np=5, opt="downpour", lr=0.2, su=1, epochs=1000, batch=64, side=8,
+        master_freq=2, device_policy="cpu", transport="tcp",
+        tcp_addrs=addrs,
+        ft_heartbeat_s=0.25, ft_lease_ttl_s=30.0, ft_op_deadline_s=5.0,
+        supervise=2,
+        server_ckpt_dir=str(tmp_path), server_ckpt_interval=2.0,
+        elastic=True, elastic_spares=1, elastic_grace_s=25.0,
+        elastic_shards_per_server=2,
+        shardctl_lease_ttl_s=30.0,
+    )
+    # Chaos arm: preempt (SIGTERM + grace) server rank 2 mid-run.  The
+    # supervisor escalates to SIGKILL only if the drain overruns.
+    import mpit_tpu.ft.supervisor as sup
+
+    orig = sup.supervise_gang
+
+    def with_chaos(*args, **kw):
+        kw.update(chaos_kill_rank=2, chaos_kill_after_s=90.0,
+                  chaos_signal=signal.SIGTERM, chaos_grace_s=25.0)
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(sup, "supervise_gang", with_chaos)
+    results = launch_processes(cfg, timeout=600)
+    roles = {r: v["role"] for r, v in results.items()}
+    assert roles[4] == "controller"
+    assert roles[1] == roles[3] == "worker"
+    ctl = results[4]
+    assert ctl["elastic_events"]["preempt"] >= 1, ctl
+    assert ctl["elastic_events"]["down"] >= 1, ctl
+    workers = [v for v in results.values() if v["role"] == "worker"]
+    assert all(w["final_test_err"] < 0.8 for w in workers)

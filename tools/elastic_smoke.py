@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""Elastic smoke — the CI job behind `elastic-smoke` (ci.yml).
+
+Runs a 2-server / 2-client / 1-controller shardctl gang (plus one spare
+server slot) twice on the in-process router under JAX_PLATFORMS=cpu:
+once static, once through three membership changes mid-run —
+
+1. **scale-up**: the controller spawns the spare as a joiner, waits for
+   its beats, and rebalances shards onto it through live migration;
+2. **graceful scale-down**: the joiner is drained (every shard migrated
+   back) and completes the RETIRE handshake — goodbye, not crash;
+3. **SIGTERM-grace preemption**: a real ``os.kill(self, SIGTERM)``
+   lands on the process; the installed notice handler sets a flag (and
+   nothing else — mtlint MT-P204), the victim server checkpoints on
+   notice, reports PREEMPT, and the controller drains + retires it
+   inside the grace window.
+
+Asserts final params are **bitwise equal** across the two runs
+(exactly-once held across every owner change), the elastic event
+counters saw all three kinds, the retired ranks exited cleanly, and the
+obs trace validates with RETIRE + MIGRATE spans present.
+
+Exit code 0 on success; any assertion or hang surfaces as a non-zero
+exit for CI.  Usage: ``python tools/elastic_smoke.py [trace.json]``.
+"""
+
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRACE = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mpit_elastic_trace.json"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Enable obs + trace export BEFORE any role object captures the registry.
+os.environ["MPIT_OBS_TRACE"] = TRACE
+
+import numpy as np  # noqa: E402
+
+from mpit_tpu.comm.local import LocalRouter  # noqa: E402
+from mpit_tpu.ft import FTConfig, PreemptionNotice  # noqa: E402
+from mpit_tpu.ps import ParamClient, ParamServer  # noqa: E402
+from mpit_tpu.shardctl import ShardController  # noqa: E402
+
+FT = FTConfig(op_deadline_s=1.0, max_retries=8,
+              backoff_base_s=0.01, backoff_cap_s=0.05)
+SIZE = 4096
+ROUNDS = 9
+GROW_AT, SHRINK_AT, PREEMPT_AT = 2, 5, 7
+
+
+def wait_for(cond, what, tick=None, timeout=30.0):
+    t0 = time.monotonic()
+    while not cond():
+        if tick is not None:
+            tick()
+        assert time.monotonic() - t0 < timeout, what
+        time.sleep(0.01)
+
+
+def run_gang(elastic: bool, ckpt_dir: str):
+    router = LocalRouter(6)
+    sranks, cranks, spare, ctl_rank = [0, 1], [2, 3], 4, 5
+    servers, threads, notices = {}, {}, {}
+
+    def make_server(r, joiner):
+        notices[r] = PreemptionNotice(grace_s=10.0)
+        if r == 1:
+            notices[r].install()  # the preemption victim gets the real handler
+        servers[r] = ParamServer(
+            r, cranks, router.endpoint(r), rule="add", ft=FT,
+            controller_rank=ctl_rank, ckpt_dir=ckpt_dir,
+            ckpt_interval=1e9, shardctl=joiner, preempt=notices[r])
+        threads[r] = threading.Thread(target=servers[r].start, daemon=True)
+        threads[r].start()
+
+    for r in sranks:
+        make_server(r, joiner=False)
+    ctl = ShardController(ctl_rank, router.endpoint(ctl_rank), sranks,
+                          cranks, spawner=lambda r: make_server(r, True),
+                          spare_ranks=[spare])
+    clients = [ParamClient(r, sranks, router.endpoint(r),
+                           seed_servers=(r == cranks[0]), ft=FT,
+                           shardctl=True, controller_rank=ctl_rank,
+                           sc_shards_per_server=2)
+               for r in cranks]
+    rng = np.random.default_rng(11)
+    w0 = rng.normal(size=SIZE).astype(np.float32)
+    gtab = rng.normal(size=(2, ROUNDS, SIZE)).astype(np.float32)
+    starters = []
+    for i, c in enumerate(clients):
+        p = w0.copy() if i == 0 else np.zeros(SIZE, np.float32)
+        starters.append(threading.Thread(
+            target=c.start, args=(p, np.zeros(SIZE, np.float32)),
+            daemon=True))
+        starters[-1].start()
+    for t in starters:
+        t.join(30)
+        assert not t.is_alive(), "client start hung"
+    ctl.pump()
+    assert ctl.smap is not None, "controller never learned the map"
+    joiner = None
+    for r in range(ROUNDS):
+        if elastic and r == GROW_AT:
+            joiner = ctl.scale_up()
+            assert len(ctl.smap.shards_of(joiner)) >= 1, "joiner shardless"
+        if elastic and r == SHRINK_AT:
+            assert ctl.scale_down(joiner), "scale-down refused"
+            threads[joiner].join(10)
+            assert not threads[joiner].is_alive(), "retired joiner hung"
+        if elastic and r == PREEMPT_AT:
+            os.kill(os.getpid(), signal.SIGTERM)  # the real notice
+            wait_for(lambda: notices[1].notified, "handler never fired")
+            wait_for(lambda: 1 in ctl.retired, "preempt drain hung",
+                     tick=ctl.pump)
+            threads[1].join(10)
+            assert not threads[1].is_alive(), "preempted server hung"
+            assert servers[1].ckpts_written >= 1, "no checkpoint-on-notice"
+        for i, c in enumerate(clients):
+            c.grad[:] = gtab[i, r]
+            c.async_send_grad()
+            c.wait()
+    clients[0].async_recv_param()
+    clients[0].wait()
+    final = clients[0].param.copy()
+    for c in clients:
+        c.stop()
+    for r, t in threads.items():
+        t.join(30)
+        assert not t.is_alive(), f"server {r} stop-protocol hung"
+    ctl.pump()
+    assert ctl.done, "controller missed client STOPs"
+    notices[1].restore()
+    return final, ctl, servers
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as ckpt:
+        static, _, _ = run_gang(elastic=False, ckpt_dir=ckpt)
+    with tempfile.TemporaryDirectory() as ckpt:
+        elastic, ctl, servers = run_gang(elastic=True, ckpt_dir=ckpt)
+
+    np.testing.assert_array_equal(static, elastic)
+    print(f"bitwise OK over {ROUNDS} rounds x 2 clients through "
+          f"grow@{GROW_AT} / drain-shrink@{SHRINK_AT} / "
+          f"SIGTERM-preempt@{PREEMPT_AT}")
+    events = {"up": int(ctl._m_up.value), "down": int(ctl._m_down.value),
+              "preempt": int(ctl._m_pre.value)}
+    assert events == {"up": 1, "down": 2, "preempt": 1}, events
+    assert ctl.membership_epoch == 3, ctl.membership_epoch
+    assert sorted(ctl.retired) == [1, 4], ctl.retired
+    assert servers[0].owned_shards == [0, 1, 2, 3]
+    print(f"elastic events {events}, membership epoch "
+          f"{ctl.membership_epoch}, survivors own {servers[0].owned_shards}")
+
+    # Export + validate the trace (single-process gang: one rank part).
+    from mpit_tpu.obs import maybe_merge_rank_traces, maybe_write_rank_trace
+    from mpit_tpu.obs.trace import validate_trace
+
+    maybe_write_rank_trace(0, role="smoke")
+    merged = maybe_merge_rank_traces()
+    assert merged, "trace export produced no file"
+    stats = validate_trace(merged)
+    print(f"trace OK: {stats}")
+    import json
+
+    with open(merged) as fh:
+        events_json = json.load(fh)["traceEvents"]
+    names = {e.get("name") for e in events_json}
+    assert "RETIRE" in names, "no RETIRE span in the trace"
+    migrate_sides = {e.get("args", {}).get("direction")
+                     for e in events_json if e.get("name") == "MIGRATE"}
+    migrate_sides.discard(None)
+    assert {"out", "in"} <= migrate_sides, \
+        f"MIGRATE spans missing a side: {migrate_sides}"
+    print("RETIRE + both-sided MIGRATE spans present")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
