@@ -22,6 +22,22 @@ At every jit *call site* (decorator or wrap):
   ``update|step|train|apply``) jitted without ``donate_argnums`` /
   ``donate_argnames`` reallocates its parameter buffers every step —
   on TPU that doubles the hot loop's HBM traffic for the updated state.
+
+Device-plane hygiene (MT-J31x) — files under a ``dplane/`` directory
+exist to keep parameters in HBM; a host transfer inside their
+apply/exchange paths silently re-introduces the round-trip the whole
+subsystem removes.  Scope: functions whose name matches
+``apply|exchange|push|pull|sync|grad|submit|service|execute``, except
+those whose name marks deliberate host/timing code
+(``host|snapshot|tim|bench``) — e.g. ``snapshot_host`` is the one
+sanctioned d2h:
+
+- **MT-J311** — host materialization: ``np.asarray`` / ``np.array`` /
+  ``np.frombuffer`` / ``np.copyto`` (any numpy root), ``device_get``
+  (bare or ``jax.``-qualified), ``.item()``, ``.tolist()``.
+- **MT-J312** — blocking device sync: ``.block_until_ready()`` — a
+  barrier on the data plane's hot path that belongs only in timing
+  code.
 """
 
 from __future__ import annotations
@@ -163,9 +179,85 @@ def _test_is_traced(test: ast.AST) -> bool:
     return False
 
 
+_DPLANE_HOT = re.compile(
+    r"apply|exchange|push|pull|sync|grad|submit|service|execute",
+    re.IGNORECASE)
+_DPLANE_EXEMPT = re.compile(r"host|snapshot|tim|bench", re.IGNORECASE)
+_HOST_XFER_ATTRS = {"asarray", "array", "frombuffer", "copyto"}
+
+
+def _in_dplane(src: SourceFile) -> bool:
+    import pathlib
+
+    return "dplane" in pathlib.PurePosixPath(src.rel).parts[:-1]
+
+
+def _dplane_functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if (_DPLANE_HOT.search(node.name)
+                    and not _DPLANE_EXEMPT.search(node.name)):
+                yield node
+
+
+def _walk_own_body(fn: ast.AST):
+    """Walk a function's statements without descending into nested defs
+    (a nested helper is scoped — and exempted — by its own name)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_dplane(src: SourceFile, findings: List[Finding]) -> None:
+    for fn in _dplane_functions(src.tree):
+        for node in _walk_own_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callee_name(node)
+            if (name in _HOST_XFER_ATTRS
+                    and isinstance(node.func, ast.Attribute)
+                    and root_name(node.func) in _NP_ROOTS):
+                findings.append(src.finding(
+                    "MT-J311", node,
+                    f"{fn.name} calls {ast.unparse(node.func)}() on the "
+                    "dplane hot path — a host materialization inside the "
+                    "device-resident apply/exchange; route it through the "
+                    "per-version snapshot cache (snapshot_host) or keep "
+                    "the value a jax.Array"))
+            elif name == "device_get":
+                findings.append(src.finding(
+                    "MT-J311", node,
+                    f"{fn.name} calls device_get() on the dplane hot "
+                    "path — the device plane exists so values never "
+                    "leave HBM; materialize only in *_host/timing code"))
+            elif (name in ("item", "tolist")
+                  and isinstance(node.func, ast.Attribute)
+                  and not node.args):
+                findings.append(src.finding(
+                    "MT-J311", node,
+                    f"{fn.name} calls .{name}() on the dplane hot path "
+                    "— a scalar host pull per op; keep it on-device or "
+                    "move it to timing/snapshot code"))
+            elif (name == "block_until_ready"
+                  and isinstance(node.func, ast.Attribute)):
+                findings.append(src.finding(
+                    "MT-J312", node,
+                    f"{fn.name} calls .block_until_ready() on the "
+                    "dplane hot path — a device barrier belongs in "
+                    "timing code only; the exchange overlaps by NOT "
+                    "fencing between ops"))
+
+
 def check(files: List[SourceFile]) -> List[Finding]:
     findings: List[Finding] = []
     for src in files:
+        if _in_dplane(src):
+            _check_dplane(src, findings)
         checked: Set[Tuple[str, int]] = set()
         for qual, body in _jitted_bodies(src):
             key = (qual, body.lineno)

@@ -1,0 +1,60 @@
+"""mpit_tpu.dplane — the device-resident parameter data plane.
+
+Every pre-dplane hot path round-trips host memory: the server snapshots
+its shard d2h, encodes on the host, and ships bytes over TCP/shm; the
+client decodes back into a host mirror and re-uploads.  The original
+port goal (SNIPPETS.md header) was the opposite — parameters living in
+HBM, exchanged over ICI collectives.  This package is that plane:
+
+- :mod:`mpit_tpu.dplane.partition` — a regex -> ``PartitionSpec`` rule
+  engine over parameter pytrees (the fmengine ``match_partition_rules``
+  shape, SNIPPETS [3]) producing ``NamedSharding``s on a mesh, plus the
+  flat-vector layer that subsumes shardctl's weighted cuts: segment
+  tables, boundary-aligned cuts, and ``plan_shard_map`` as the layout
+  source for versioned shard maps.
+- :mod:`mpit_tpu.dplane.hbm` — device-resident shard slots: a shard's
+  params and optimizer state live as (optionally mesh-sharded)
+  ``jax.Array``s and ``rule.apply`` is jitted with ``donate_argnums``
+  so an update never leaves HBM; per-version snapshot (d2h) and pull
+  (all-gather) caches keep reads one-copy.
+- :mod:`mpit_tpu.dplane.exchange` — the client<->server exchange that
+  stays on-device when ranks share a backend (a process-local plane
+  registry + backend fingerprints decide) and falls back transparently
+  to the framed wire path — codecs, retry/dedup, shard maps all intact
+  — across hosts (docs/DEVICE.md has the decision table).
+"""
+
+from mpit_tpu.dplane.partition import (
+    Segment,
+    aligned_cut,
+    flat_segments,
+    match_partition_rules,
+    match_report,
+    named_tree_map,
+    plan_shard_map,
+    tree_shardings,
+)
+from mpit_tpu.dplane.hbm import (
+    HbmSlot,
+    PlaneConfig,
+    dedupe_state,
+    place_flat,
+    place_state,
+)
+from mpit_tpu.dplane.exchange import (
+    DevicePlane,
+    ExchangeClient,
+    ExchangeError,
+    backend_fingerprint,
+    lookup,
+    publish,
+    withdraw,
+)
+
+__all__ = [
+    "Segment", "aligned_cut", "flat_segments", "match_partition_rules",
+    "match_report", "named_tree_map", "plan_shard_map", "tree_shardings",
+    "HbmSlot", "PlaneConfig", "dedupe_state", "place_flat", "place_state",
+    "DevicePlane", "ExchangeClient", "ExchangeError",
+    "backend_fingerprint", "lookup", "publish", "withdraw",
+]

@@ -1,0 +1,382 @@
+"""The device exchange: client<->server param traffic that stays in HBM.
+
+Topology decision, made once per (client, server) pair at ``start``:
+
+- the server published a :class:`DevicePlane` in this process's plane
+  registry, its backend fingerprint matches the client's, the codec is
+  identity, and the gang is on the static shard cut  ==>  **device
+  path**: ops are submitted straight to the server's plane queue and
+  executed by the server's own service task against its
+  :class:`~mpit_tpu.dplane.hbm.HbmSlot` — grads ride as ``jax.Array``s,
+  pulls return the slot's per-version replicated array (an all-gather,
+  never a d2h), and delivery is exactly-once by construction (an
+  in-process queue cannot drop, duplicate, or reorder);
+- anything else  ==>  **wire fallback**: the op runs through the inner
+  :class:`~mpit_tpu.ps.client.ParamClient` completely unchanged —
+  codecs, [epoch, seq] framing, retry/dedup, NACK re-routing, shard
+  maps all intact.  docs/DEVICE.md §3 is the normative decision table;
+  docs/PROTOCOL.md §10 pins the boundary.
+
+The protocol wire is **always** live even for all-device gangs: INIT,
+seeding, heartbeats and STOP ride it, so lease/eviction semantics and
+the stop protocol are identical in every mode — the device path is a
+data-plane bypass, not a second protocol.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from mpit_tpu.obs import registry_or_local
+from mpit_tpu.utils.logging import get_logger
+
+
+class ExchangeError(RuntimeError):
+    """A device-path op failed terminally (server stopped / timed out).
+    The never-hang analog of RetryExhausted for the in-process plane."""
+
+
+def backend_fingerprint(devices=None) -> Tuple[int, str]:
+    """(pid, platform) — two ranks share a backend when both match.
+    Process identity is what makes the in-process queue sound; platform
+    identity is what makes device arrays from one side consumable by
+    the other without a host hop."""
+    if devices:
+        platform = devices[0].platform
+    else:
+        from mpit_tpu.utils.platform import default_devices
+
+        platform = default_devices()[0].platform
+    return (os.getpid(), platform)
+
+
+# ---------------------------------------------------------------------------
+# the process-local plane registry (the rendezvous for the device path)
+
+
+_registry: Dict[Tuple[str, int], "DevicePlane"] = {}
+_registry_lock = threading.Lock()
+
+
+def publish(rank: int, plane: "DevicePlane", namespace: str = "") -> None:
+    with _registry_lock:
+        _registry[(namespace, rank)] = plane
+
+
+def withdraw(rank: int, namespace: str = "") -> None:
+    with _registry_lock:
+        _registry.pop((namespace, rank), None)
+
+
+def lookup(rank: int, namespace: str = "") -> "Optional[DevicePlane]":
+    with _registry_lock:
+        return _registry.get((namespace, rank))
+
+
+class DeviceTicket:
+    """One submitted device op; the client blocks on ``event``."""
+
+    __slots__ = ("kind", "crank", "srank", "payload", "event", "result",
+                 "error")
+
+    def __init__(self, kind: str, crank: int, srank: int, payload=None):
+        self.kind = kind  # 'grad' | 'push' | 'pull' | 'pull_dev'
+        self.crank = crank
+        self.srank = srank
+        self.payload = payload
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class DevicePlane:
+    """A server's published device-exchange endpoint: a FIFO ticket
+    queue drained by the server's own scheduler task, so device ops
+    serialize with wire ops under the server's single-writer
+    discipline (serve-latest-committed, no torn state)."""
+
+    def __init__(self, rank: int, fingerprint: Tuple[int, str]):
+        self.rank = rank
+        self.fingerprint = fingerprint
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._closed: Optional[str] = None
+
+    def submit(self, ticket: DeviceTicket) -> DeviceTicket:
+        with self._lock:
+            if self._closed is not None:
+                raise ExchangeError(
+                    f"device plane of server {self.rank} is closed "
+                    f"({self._closed})")
+            self._q.append(ticket)
+        return ticket
+
+    def pop(self) -> Optional[DeviceTicket]:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def close(self, reason: str) -> None:
+        """Terminal: fail every queued ticket loudly — a client blocked
+        on a stopped server's plane must raise, never hang."""
+        with self._lock:
+            self._closed = reason
+            pending = list(self._q)
+            self._q.clear()
+        for t in pending:
+            t.error = ExchangeError(
+                f"server {self.rank} stopped before serving the "
+                f"{t.kind} op ({reason})")
+            t.event.set()
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+
+# ---------------------------------------------------------------------------
+# client side
+
+
+class ExchangeClient:
+    """ParamClientAPI front for a :class:`ParamClient` that routes each
+    server's data ops over the device path when eligible and the wire
+    otherwise.  Drop-in for the comm-aware optimizers: they keep writing
+    the host mirrors; :meth:`sync_device` is the extra, fully
+    device-resident round for trainers that hold ``jax.Array``s."""
+
+    def __init__(self, inner, *, device_ranks: Optional[Sequence[int]] = None,
+                 namespace: str = "", require_device: bool = False):
+        self.pc = inner
+        self.namespace = namespace
+        self._forced = list(device_ranks) if device_ranks is not None else None
+        self._require = require_device
+        self._planes: Dict[int, DevicePlane] = {}
+        self._pending: List[DeviceTicket] = []
+        self.log = get_logger("dplane", inner.rank)
+        _m = registry_or_local()
+        self._m_dev_ranks = _m.gauge("mpit_dplane_device_ranks",
+                                     rank=inner.rank)
+        self._m_wire_ranks = _m.gauge("mpit_dplane_wire_fallback_ranks",
+                                      rank=inner.rank)
+        self._m_ops = {
+            "device": _m.counter("mpit_dplane_exchange_ops_total",
+                                 rank=inner.rank, path="device"),
+            "wire": _m.counter("mpit_dplane_exchange_ops_total",
+                               rank=inner.rank, path="wire"),
+        }
+
+    # -- mirrors (honor inner.reset retargets) -------------------------------
+
+    @property
+    def param(self) -> np.ndarray:
+        return self.pc.param
+
+    @property
+    def grad(self) -> np.ndarray:
+        return self.pc.grad
+
+    @property
+    def device_ranks(self) -> List[int]:
+        return sorted(self._planes)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, param: np.ndarray, grad: np.ndarray) -> None:
+        """Full wire handshake first (INIT + seeding are protocol, not
+        data), then resolve which servers are device-eligible."""
+        self.pc.start(param, grad)
+        self._resolve()
+
+    def _resolve(self) -> None:
+        self._planes.clear()
+        fp = backend_fingerprint()
+        eligible = self.pc.codec.identity and not getattr(
+            self.pc, "_sc", False)
+        for srank in self.pc.sranks:
+            if self._forced is not None and srank not in self._forced:
+                continue
+            plane = lookup(srank, self.namespace)
+            if plane is not None and eligible and plane.fingerprint == fp:
+                self._planes[srank] = plane
+        if self._forced is not None:
+            missing = set(self._forced) - set(self._planes)
+            if missing:
+                raise ExchangeError(
+                    f"device_ranks {sorted(missing)} are not "
+                    "device-eligible (no published plane, fingerprint "
+                    "mismatch, non-identity codec, or shardctl mode)")
+        if self._require and len(self._planes) < len(self.pc.sranks):
+            wire = sorted(set(self.pc.sranks) - set(self._planes))
+            raise ExchangeError(
+                f"require_device: servers {wire} fell back to the wire")
+        self._m_dev_ranks.set(len(self._planes))
+        self._m_wire_ranks.set(len(self.pc.sranks) - len(self._planes))
+        if self._planes:
+            self.log.info(
+                "device exchange to servers %s (wire fallback: %s)",
+                self.device_ranks,
+                sorted(set(self.pc.sranks) - set(self._planes)))
+
+    def reset(self, param: np.ndarray, grad: np.ndarray) -> None:
+        self.pc.reset(param, grad)
+
+    def _deadline_s(self) -> float:
+        ft = self.pc.ft
+        if ft.op_deadline_s > 0:
+            return ft.op_deadline_s * (ft.max_retries + 1) + 5.0
+        return 60.0
+
+    def _submit(self, srank: int, kind: str, payload=None) -> None:
+        ticket = DeviceTicket(kind, self.pc.rank, srank, payload)
+        self._planes[srank].submit(ticket)
+        self._pending.append(ticket)
+        self._m_ops["device"].inc()
+
+    # -- ParamClientAPI ------------------------------------------------------
+
+    def async_send_grad(self) -> None:
+        for srank, shard in zip(self.pc.sranks, self.pc.shards):
+            if srank in self._planes:
+                # Submit-time copy onto the device == the wire path's
+                # encode-at-ship staging: the optimizer may rewrite the
+                # mirror the moment wait() returns.
+                view = self.grad[shard.offset:shard.end]
+                self._submit(srank, "grad", jax.numpy.asarray(view))
+            else:
+                self._m_ops["wire"].inc()
+                self.pc.enqueue_wire_op(
+                    srank, self.pc._send_grad(srank, shard), "send_grad")
+
+    def async_recv_param(self) -> None:
+        for srank, shard in zip(self.pc.sranks, self.pc.shards):
+            if srank in self._planes:
+                self._submit(srank, "pull")
+            else:
+                self._m_ops["wire"].inc()
+                self.pc.enqueue_wire_op(
+                    srank, self.pc._recv_param(srank, shard), "recv_param")
+
+    def async_send_param(self) -> None:
+        for srank, shard in zip(self.pc.sranks, self.pc.shards):
+            if srank in self._planes:
+                view = self.param[shard.offset:shard.end]
+                self._submit(srank, "push", jax.numpy.asarray(view))
+            else:
+                self._m_ops["wire"].inc()
+                self.pc.enqueue_wire_op(
+                    srank, self.pc._send_param(srank, shard), "send_param")
+
+    def ping(self, n: int = 1) -> None:
+        self.pc.ping(n)
+
+    def wait(self) -> None:
+        """Drain the wire, then the device tickets.  A pull ticket's
+        result is the slot's per-version host snapshot — written into
+        the registered param mirror exactly where the wire path would
+        decode it."""
+        self.pc.wait()
+        pending, self._pending = self._pending, []
+        deadline = self._deadline_s()
+        shard_of = dict(zip(self.pc.sranks, self.pc.shards))
+        for ticket in pending:
+            if not ticket.event.wait(deadline):
+                raise ExchangeError(
+                    f"device {ticket.kind} op timed out after "
+                    f"{deadline:.1f}s (server service stalled?)")
+            if ticket.error is not None:
+                raise ticket.error
+            if ticket.kind == "pull":
+                shard = shard_of[ticket.srank]
+                self.param[shard.offset:shard.end] = ticket.result
+
+    def stop(self) -> None:
+        self.pc.stop()
+
+    def residual_norm(self) -> float:
+        return self.pc.residual_norm()
+
+    @property
+    def retries(self) -> int:
+        return self.pc.retries
+
+    # -- the fully device-resident round ------------------------------------
+
+    def sync_device(self, update, *, pull: bool = True,
+                    concat: bool = True):
+        """One PS round that never touches the host for device-eligible
+        servers.  ``update`` is either one flat ``jax.Array`` (sliced
+        per shard on device) or a per-shard list of device arrays — the
+        sharded-native form a TPU loop holds anyway, which skips the
+        slice entirely.  Refreshed params come back as one concatenated
+        vector (``concat=True``) or the per-shard list (``concat=False``
+        — again the zero-extra-copy sharded form).  Wire-fallback
+        servers are staged through the host mirrors by
+        :meth:`_stage_wire_host` (the one sanctioned host hop, and only
+        for those ranks)."""
+        parts_in = isinstance(update, (list, tuple))
+        if parts_in and len(update) != len(self.pc.shards):
+            raise ValueError(
+                f"{len(update)} update parts for {len(self.pc.shards)} "
+                "shards")
+        wire_ranks = [s for s in self.pc.sranks if s not in self._planes]
+        if wire_ranks:
+            self._stage_wire_host(update, wire_ranks, parts_in)
+        for idx, (srank, shard) in enumerate(
+                zip(self.pc.sranks, self.pc.shards)):
+            if srank in self._planes:
+                g = (update[idx] if parts_in
+                     else update[shard.offset:shard.end])
+                self._submit(srank, "grad", g)
+                if pull:
+                    self._submit(srank, "pull_dev")
+        if not pull:
+            self.wait()
+            return None
+        self.pc.wait()
+        pending, self._pending = self._pending, []
+        deadline = self._deadline_s()
+        pulls: Dict[int, Any] = {}
+        for ticket in pending:
+            if not ticket.event.wait(deadline):
+                raise ExchangeError(
+                    f"device {ticket.kind} op timed out after "
+                    f"{deadline:.1f}s (server service stalled?)")
+            if ticket.error is not None:
+                raise ticket.error
+            if ticket.kind == "pull_dev":
+                pulls[ticket.srank] = ticket.result
+        parts = []
+        for srank, shard in zip(self.pc.sranks, self.pc.shards):
+            if srank in pulls:
+                parts.append(pulls[srank])
+            else:
+                parts.append(jax.numpy.asarray(
+                    self.param[shard.offset:shard.end]))
+        if not concat:
+            return parts
+        return jax.numpy.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def _stage_wire_host(self, update, wire_ranks: List[int],
+                         parts_in: bool = False) -> None:
+        """Materialize the wire-fallback ranks' updates once and run
+        their framed send+recv ops — fully inside the existing
+        retry/dedup machinery."""
+        host = None if parts_in else np.asarray(update)
+        for idx, (srank, shard) in enumerate(
+                zip(self.pc.sranks, self.pc.shards)):
+            if srank in wire_ranks:
+                self.grad[shard.offset:shard.end] = (
+                    np.asarray(update[idx]) if parts_in
+                    else host[shard.offset:shard.end])
+                self._m_ops["wire"].inc()
+                self.pc.enqueue_wire_op(
+                    srank, self.pc._send_grad(srank, shard), "send_grad")
+                self.pc.enqueue_wire_op(
+                    srank, self.pc._recv_param(srank, shard), "recv_param")

@@ -1,0 +1,264 @@
+"""Device-resident shard slots — params and optimizer state stay in HBM.
+
+An :class:`HbmSlot` is the device-side body of one PS shard: the
+parameter slice and its rule (optimizer) state live as ``jax.Array``s —
+optionally sharded over a mesh axis — and every update runs one jitted
+``decode + rule.apply`` XLA program compiled with ``donate_argnums`` on
+the param and state, so the update writes back into the same HBM
+footprint instead of reallocating it (the MT-J303 contract, now load
+bearing: a donated buffer is deleted, which tests assert).
+
+Reads are cached per committed version, mirroring the PR 2 snapshot
+cache on both sides of the host boundary:
+
+- :meth:`HbmSlot.snapshot_host` — ONE device->host copy per version
+  (the wire path's d2h; name carries ``host`` on purpose: it is the
+  only sanctioned host materialization in this module — mtlint
+  MT-J311);
+- :meth:`HbmSlot.pull_device` — ONE replicate program per version: a
+  jitted identity with replicated ``out_shardings``, which XLA lowers
+  to an all-gather over the shard axis.  The result is a *fresh* buffer
+  (never an alias of the param), so a later donated apply cannot delete
+  an array a puller still holds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from mpit_tpu.obs import registry_or_local
+from mpit_tpu.optim.rules import ShardRule
+
+
+@dataclasses.dataclass(frozen=True)
+class PlaneConfig:
+    """How a server places + serves its device-resident shards.
+
+    ``mesh=None`` places on the default device unsharded; with a mesh,
+    flat vectors shard over ``axis`` when divisible (else replicate —
+    the naive fallback, never an error).  ``publish=False`` keeps the
+    slots device-resident without offering the in-process exchange
+    (``namespace`` isolates concurrent gangs in one process)."""
+
+    mesh: Optional[Mesh] = None
+    axis: str = "shard"
+    donate: bool = True
+    publish: bool = True
+    namespace: str = ""
+
+    @classmethod
+    def auto(cls, **kw) -> "PlaneConfig":
+        """Mesh over every default device when more than one exists
+        (all on the shard axis), else single-device placement."""
+        from mpit_tpu.parallel.mesh import make_mesh
+        from mpit_tpu.utils.platform import default_devices
+
+        devs = default_devices()
+        mesh = make_mesh(devs, dp=1) if len(devs) > 1 else None
+        return cls(mesh=mesh, **kw)
+
+
+def flat_sharding(cfg: PlaneConfig, size: int) -> Optional[NamedSharding]:
+    """The sharding a flat ``(size,)`` vector gets under ``cfg``."""
+    if cfg.mesh is None:
+        return None
+    n = cfg.mesh.shape[cfg.axis]
+    spec = PartitionSpec(cfg.axis) if size % n == 0 else PartitionSpec()
+    return NamedSharding(cfg.mesh, spec)
+
+
+def place_flat(arr, cfg: Optional[PlaneConfig]):
+    """Place a flat vector per ``cfg`` (plain ``jnp.asarray`` when no
+    plane is configured) — the one placement helper every dplane call
+    site shares, so server/shardctl/exchange cannot disagree."""
+    if cfg is None:
+        return jnp.asarray(arr)
+    sharding = flat_sharding(cfg, int(np.shape(arr)[0]))
+    if sharding is None:
+        return jnp.asarray(arr)
+    return jax.device_put(arr, sharding)
+
+
+_identity_copy = None
+
+
+def _device_copy(x):
+    """A bit-exact fresh buffer for ``x`` (jitted identity: jax/XLA
+    never alias an un-donated output to its input, verified by the
+    donation tests).  Stays on device; preserves sharding."""
+    global _identity_copy
+    if _identity_copy is None:
+        _identity_copy = jax.jit(lambda v: v)
+    return _identity_copy(x)
+
+
+def dedupe_state(state):
+    """Break buffer aliasing inside a rule-state dict: several rules
+    init multiple entries from ONE ``zeros_like`` array (e.g. Adam's m
+    and v), which a donated apply would donate twice — an XLA error.
+    Aliased leaves get a fresh device copy; distinct leaves pass
+    through untouched."""
+    seen: set = set()
+    out = {}
+    for k, v in (state or {}).items():
+        if id(v) in seen:
+            v = _device_copy(v)
+        seen.add(id(v))
+        out[k] = v
+    return out
+
+
+def place_state(state, cfg: Optional[PlaneConfig]):
+    """Place a rule-state pytree next to its param: flat arrays follow
+    the param's sharding, scalars replicate.  Always de-aliased — see
+    :func:`dedupe_state`."""
+    if cfg is None or cfg.mesh is None:
+        return dedupe_state(
+            {k: jnp.asarray(v) for k, v in (state or {}).items()})
+
+    def put(v):
+        shape = np.shape(v)
+        if len(shape) == 1:
+            return place_flat(v, cfg)
+        return jax.device_put(
+            v, NamedSharding(cfg.mesh, PartitionSpec()))
+
+    return dedupe_state({k: put(v) for k, v in (state or {}).items()})
+
+
+class HbmSlot:
+    """One device-resident shard: param + rule state + versioned caches."""
+
+    def __init__(self, size: int, rule: ShardRule, dtype=np.float32, *,
+                 config: Optional[PlaneConfig] = None, rank: int = -1):
+        self.size = int(size)
+        self.rule = rule
+        self.dtype = np.dtype(dtype)
+        self.config = config or PlaneConfig()
+        self.rank = rank
+        self.param = place_flat(np.zeros(self.size, self.dtype), self.config)
+        self.rule_state = dedupe_state(rule.init(self.param))
+        #: committed version: bumps on every apply/seed (the snapshot
+        #: cache key, same meaning as the server's _snap_version)
+        self.version = 0
+        self._fused: Dict[Optional[str], Callable] = {}
+        self._snap_host: Optional[Tuple[int, np.ndarray]] = None
+        self._pull_cache: Optional[Tuple[int, Any]] = None
+        self._replicate: Optional[Callable] = None
+        _m = registry_or_local()
+        self._m_applies = _m.counter("mpit_dplane_device_applies_total",
+                                     rank=rank)
+        self._m_copies = _m.counter("mpit_dplane_snapshot_copies_total",
+                                    rank=rank)
+        self._m_gathers = _m.counter("mpit_dplane_pull_gathers_total",
+                                     rank=rank)
+        self._m_bytes = _m.gauge("mpit_dplane_hbm_bytes", rank=rank)
+        self._m_bytes.set(self.size * self.dtype.itemsize)
+
+    # -- write path: one donated XLA program per update ---------------------
+
+    def _fused_apply(self, codec=None) -> Callable:
+        """The jitted update for one codec (None = device-native grads):
+        frame decode fused with ``rule.apply``, param + state donated —
+        the whole update is one XLA call that never leaves HBM."""
+        key = codec.name if codec is not None else None
+        fn = self._fused.get(key)
+        if fn is None:
+            rule_apply = self.rule.apply
+            if codec is None or codec.identity:
+                body = rule_apply
+            else:
+                size = self.size
+
+                def body(param, parts, state):
+                    return rule_apply(param, codec.decode_parts(parts, size),
+                                      state)
+
+            donate = (0, 2) if self.config.donate else ()
+            fn = jax.jit(body, donate_argnums=donate)
+            self._fused[key] = fn
+        return fn
+
+    def _invalidate(self) -> None:
+        self.version += 1
+        self._pull_cache = None
+
+    def apply_grad(self, grad) -> None:
+        """Apply one device-native gradient (identity wire format): the
+        grad is placed with the param's sharding and the donated update
+        runs; the old param/state buffers are consumed in place."""
+        g = place_flat(grad, self.config)
+        self.param, self.rule_state = self._fused_apply()(
+            self.param, g, self.rule_state)
+        self._m_applies.inc()
+        self._invalidate()
+
+    def apply_wire(self, codec, grad_in) -> None:
+        """Apply one wire-format gradient: ``grad_in`` is the decoded
+        host view (identity codecs) or the codec's split wire parts,
+        exactly as the server's legacy path builds them — same math,
+        same operand order, so device and host runs stay bitwise equal."""
+        if codec.identity:
+            self.apply_grad(grad_in)
+            return
+        parts = [jnp.asarray(v) for v in grad_in]
+        self.param, self.rule_state = self._fused_apply(codec)(
+            self.param, parts, self.rule_state)
+        self._m_applies.inc()
+        self._invalidate()
+
+    def seed(self, value) -> None:
+        """Whole-shard write (seeding / PARAM_PUSH): re-place, new
+        version.  Rule state is deliberately kept — the reference's
+        seed overwrites params only."""
+        self.param = place_flat(value, self.config)
+        self._invalidate()
+
+    # -- read path: per-version caches on both sides of the boundary --------
+
+    def snapshot_host(self) -> np.ndarray:
+        """This version's device->host copy, cached: N wire reads of one
+        committed version cost one d2h however many clients ask."""
+        if self._snap_host is None or self._snap_host[0] != self.version:
+            self._snap_host = (self.version, np.asarray(self.param))
+            self._m_copies.inc()
+        return self._snap_host[1]
+
+    def pull_device(self):
+        """This version's replicated device array, cached: the device
+        analog of the snapshot cache.  Lowered by XLA to an all-gather
+        over the shard axis (sharded slots) or a device copy; always a
+        fresh buffer, so the donated apply can never delete it out from
+        under a holder."""
+        cached = self._pull_cache
+        if cached is not None and cached[0] == self.version:
+            return cached[1]
+        if self._replicate is None:
+            if self.config.mesh is not None:
+                out = NamedSharding(self.config.mesh, PartitionSpec())
+                self._replicate = jax.jit(lambda p: p, out_shardings=out)
+            else:
+                self._replicate = jax.jit(lambda p: p)
+        pulled = self._replicate(self.param)
+        self._m_gathers.inc()
+        self._pull_cache = (self.version, pulled)
+        return pulled
+
+    # -- introspection -------------------------------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        sharding = getattr(self.param, "sharding", None)
+        return {
+            "size": self.size,
+            "dtype": self.dtype.name,
+            "version": self.version,
+            "devices": (len(sharding.device_set)
+                        if sharding is not None else 1),
+            "donate": self.config.donate,
+        }

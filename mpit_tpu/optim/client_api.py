@@ -41,3 +41,17 @@ class ParamClientAPI(Protocol):
         """Block until all enqueued transfers complete."""
 
     def stop(self) -> None: ...
+
+
+@runtime_checkable
+class DeviceSyncAPI(ParamClientAPI, Protocol):
+    """Optional extension (mpit_tpu.dplane.ExchangeClient): a PS round
+    that stays in device memory.  ``sync_device(update)`` ships a flat
+    ``jax.Array`` update and returns the refreshed parameter vector as
+    a device array — no host mirrors touched for device-eligible
+    servers (wire-fallback servers are staged through the mirrors
+    transparently).  Trainers should feature-test with
+    ``isinstance(pc, DeviceSyncAPI)`` and keep the mirror path as the
+    universal fallback."""
+
+    def sync_device(self, update, *, pull: bool = True): ...

@@ -26,19 +26,30 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.6: public API (check_vma kwarg)
+try:  # jax >= 0.6: public API (check_vma kwarg) — pass through as-is.
     from jax import shard_map
-except ImportError:  # older jax: experimental home, check_rep kwarg.
-    # Same shape as the pallas TPUCompilerParams/CompilerParams rename
-    # shim (ops/flash_attention.py): adapt the one renamed kwarg instead
-    # of pinning a jax version.  Siblings (moe, fused, ring_attention,
+except ImportError:
+    # Older jax: experimental home.  Audited against the live signature
+    # instead of assuming a kwarg name: 0.4.x spells the replication
+    # check ``check_rep``; some intermediate builds renamed it to
+    # ``check_vma`` in place, and dropping the check entirely is the
+    # safe degradation for anything else (every call site here passes
+    # check_vma=False anyway — the collectives below are deliberately
+    # replication-breaking).  Siblings (moe, fused, ring_attention,
     # tensor_parallel, pipeline) import shard_map from here.
+    import inspect
+
     from jax.experimental.shard_map import shard_map as _shard_map_exp
 
+    _CHECK_KW = next(
+        (kw for kw in ("check_rep", "check_vma")
+         if kw in inspect.signature(_shard_map_exp).parameters), None)
+
     def shard_map(f, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        if _CHECK_KW is not None:
+            kwargs[_CHECK_KW] = check_vma
         return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=check_vma,
-                              **kwargs)
+                              out_specs=out_specs, **kwargs)
 
 
 def ps_pull(mesh: Mesh, axis: str = "shard") -> Callable[[jnp.ndarray], jnp.ndarray]:

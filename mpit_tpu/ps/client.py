@@ -1050,6 +1050,15 @@ class ParamClient:
         finally:
             self._pump_live[srank] = False
 
+    def enqueue_wire_op(self, srank: int, gen: Generator,
+                        name: str) -> None:
+        """Public hook for the device exchange (mpit_tpu.dplane): run
+        one wire op generator through ``srank``'s FIFO pump, exactly as
+        the ``async_*`` conveniences do.  The dplane ExchangeClient
+        routes per-server — device-eligible servers bypass the wire,
+        everyone else enters here with codecs/framing/retry intact."""
+        self._enqueue(srank, gen, name)
+
     def async_send_grad(self) -> None:
         if self._sc:
             for e in self.smap.entries:

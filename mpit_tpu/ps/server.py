@@ -100,6 +100,8 @@ from mpit_tpu.ft import (
     unpack_tx_stamp,
     unpack_version,
 )
+from mpit_tpu.dplane import exchange as _dpexchange
+from mpit_tpu.dplane import hbm as _dphbm
 from mpit_tpu.obs import (
     get_flight,
     get_recorder,
@@ -149,6 +151,13 @@ class ParamServer:
         preempt: "Optional[Any]" = None,  # ft.elastic.PreemptionNotice —
         #                                   checkpoint-on-notice + PREEMPT
         #                                   report when it fires (§9.3)
+        dplane: "Optional[_dphbm.PlaneConfig]" = None,  # device-resident
+        #                          data plane (mpit_tpu.dplane): shard +
+        #                          rule state live as (mesh-sharded) HBM
+        #                          arrays with donated jitted applies;
+        #                          publish=True additionally offers the
+        #                          in-process device exchange.  Wins over
+        #                          the `device` placement knob.
     ):
         self.rank = rank
         self.cranks = list(client_ranks)
@@ -313,10 +322,20 @@ class ParamServer:
         self._snap_version = 0
         self._snap_host: Optional[Tuple[int, np.ndarray]] = None
         self._snap_wire: Dict[str, Tuple[int, np.ndarray]] = {}
+        # Device-resident data plane (mpit_tpu.dplane): the shard lives
+        # in an HbmSlot (donated jitted applies, per-version snapshot +
+        # pull caches) and, when published, an in-process DevicePlane
+        # serves same-backend clients without touching the wire.
+        self._dp_cfg = dplane
+        self._hbm: "Optional[_dphbm.HbmSlot]" = None
+        self._plane: "Optional[_dpexchange.DevicePlane]" = None
+        self._m_dp_ops: Dict[str, Any] = {}
         if device not in ("cpu", "default"):
             raise ValueError(f"device must be 'cpu' or 'default', got {device!r}")
         self._device = None
-        if device == "cpu":
+        if dplane is not None:
+            pass  # plane placement wins: slots live on the default backend
+        elif device == "cpu":
             try:
                 self._device = jax.local_devices(backend="cpu")[0]
             except RuntimeError:
@@ -359,6 +378,8 @@ class ParamServer:
             "busy_replies": int(self._m_busy.value),
             "retired": self.retired,
             "retiring_to": self._serve_successor,
+            "dplane": (self._hbm.describe()
+                       if self._hbm is not None else None),
             "serve_inflight_bytes": self._serve_inflight_bytes,
             "clients": {
                 str(c): {
@@ -503,9 +524,16 @@ class ParamServer:
             )
         if self.offset == -1:
             self.offset, self.size = offset, size
-            with self._dev_ctx():
-                self.param = jnp.zeros((size,), dtype=self.dtype)
-                self.rule_state = self.rule.init(self.param)
+            if self._dp_cfg is not None:
+                self._hbm = _dphbm.HbmSlot(size, self.rule, self.dtype,
+                                           config=self._dp_cfg,
+                                           rank=self.rank)
+                self.param = self._hbm.param
+                self.rule_state = self._hbm.rule_state
+            else:
+                with self._dev_ctx():
+                    self.param = jnp.zeros((size,), dtype=self.dtype)
+                    self.rule_state = self.rule.init(self.param)
         else:
             # All clients must agree on this server's shard (reference :87-88).
             assert (self.offset, self.size) == (offset, size), (
@@ -592,12 +620,37 @@ class ParamServer:
 
     def _sc_make_slot(self, sid: int, shard) -> ShardSlot:
         slot = ShardSlot(sid, shard.offset, shard.size)
-        with self._dev_ctx():
-            slot.param = jnp.zeros((shard.size,), dtype=self.dtype)
-            slot.rule_state = self.rule.init(slot.param)
+        slot.param = self._place_param(np.zeros(shard.size, self.dtype))
+        slot.rule_state = self._init_state(slot.param)
         self._slots[sid] = slot
         self._m_sc_owned.set(len(self._slots))
         return slot
+
+    def _place_param(self, arr):
+        """Place one flat param vector on this server's backend: the
+        dplane placement (mesh-sharded HBM) when configured, else the
+        legacy device context.  Rule state built from the result
+        inherits the placement (zeros_like preserves sharding)."""
+        if self._dp_cfg is not None:
+            return _dphbm.place_flat(arr, self._dp_cfg)
+        with self._dev_ctx():
+            return jnp.asarray(arr)
+
+    def _place_state(self, state):
+        """Place a restored rule-state dict next to its param."""
+        if self._dp_cfg is not None:
+            return _dphbm.place_state(state, self._dp_cfg)
+        with self._dev_ctx():
+            return {k: jnp.asarray(v) for k, v in state.items()}
+
+    def _init_state(self, param):
+        """Fresh rule state for ``param``.  Donated applies (dplane)
+        need the aliased zeros_like leaves some rules share broken
+        apart — donating one buffer twice is an XLA error."""
+        state = self.rule.init(param)
+        if self._dp_cfg is not None and self._dp_cfg.donate:
+            state = _dphbm.dedupe_state(state)
+        return state
 
     def _hdr_for(self, crank: int) -> int:
         """Header size of this client's data frames (GRAD/PARAM_PUSH)."""
@@ -707,14 +760,19 @@ class ParamServer:
         fn = self._sc_apply_cache.get(key)
         if fn is None:
             rule_apply = self.rule.apply
+            # Device-resident slots (dplane) donate param + rule state:
+            # the update consumes its HBM footprint in place instead of
+            # reallocating it (the MT-J303 contract, load-bearing here).
+            donate = ((0, 2) if self._dp_cfg is not None
+                      and self._dp_cfg.donate else ())
             if codec.identity:
-                fn = jax.jit(rule_apply)
+                fn = jax.jit(rule_apply, donate_argnums=donate)
             else:
                 def _decode_apply(param, parts, state):
                     return rule_apply(param, codec.decode_parts(parts, size),
                                       state)
 
-                fn = jax.jit(_decode_apply)
+                fn = jax.jit(_decode_apply, donate_argnums=donate)
             self._sc_apply_cache[key] = fn
         return fn
 
@@ -738,8 +796,14 @@ class ParamServer:
         return buf
 
     def _committed(self) -> None:
-        """A new shard version exists (grad applied / params seeded)."""
-        self._snap_version += 1
+        """A new shard version exists (grad applied / params seeded).
+        With a device-resident slot the slot's counter is authoritative
+        (device-exchange applies bump it too); mirror it here so the
+        wire snapshot cache keys on the same stream."""
+        if self._hbm is not None:
+            self._snap_version = self._hbm.version
+        else:
+            self._snap_version += 1
 
     def _snapshot_wire(self, codec: "codec_mod.Codec") -> np.ndarray:
         """The current version's PARAM frame for ``codec``, cached: N
@@ -753,8 +817,13 @@ class ParamServer:
             return cached[1]
         if self._snap_host is None or self._snap_host[0] != version:
             # Serve-latest-committed: np.asarray snapshots the current
-            # immutable device array (the one device->host copy).
-            self._snap_host = (version, np.asarray(self.param))
+            # immutable device array (the one device->host copy).  A
+            # device-resident slot shares its own per-version d2h cache
+            # here, so wire reads, checkpoints and the device exchange
+            # all draw from the same single copy.
+            host = (self._hbm.snapshot_host() if self._hbm is not None
+                    else np.asarray(self.param))
+            self._snap_host = (version, host)
             self._m_snap_copies.inc()
         host = self._snap_host[1]
         if codec.identity:
@@ -896,8 +965,12 @@ class ParamServer:
             else:  # cold path: host decode, then one h2d
                 host = self._push_host[crank]
                 codec.decode_into(staging[hdr:], host)
-            with self._dev_ctx():
-                self.param = jnp.asarray(host)
+            if self._hbm is not None:
+                self._hbm.seed(host)
+                self.param = self._hbm.param
+            else:
+                with self._dev_ctx():
+                    self.param = jnp.asarray(host)
             self._committed()
             span.mark("ack")
             if framed:
@@ -1256,14 +1329,24 @@ class ParamServer:
                     span.note(staleness=staleness)
                     self._stale_hist(crank).observe(staleness)
             span.mark("apply")
-            with self._dev_ctx():
-                if parts is None:
-                    grad_in: Any = jnp.asarray(data if data is not None else gbuf)
-                else:
-                    grad_in = [jnp.asarray(v) for v in parts]
-                self.param, self.rule_state = apply_fn(
-                    self.param, grad_in, self.rule_state
-                )
+            if self._hbm is not None:
+                # Device-resident path: the slot's donated fused
+                # decode+apply — same math, same operand order as the
+                # legacy jit below, so both runs stay bitwise equal.
+                self._hbm.apply_wire(
+                    codec, data if parts is None else parts)
+                self.param = self._hbm.param
+                self.rule_state = self._hbm.rule_state
+            else:
+                with self._dev_ctx():
+                    if parts is None:
+                        grad_in: Any = jnp.asarray(
+                            data if data is not None else gbuf)
+                    else:
+                        grad_in = [jnp.asarray(v) for v in parts]
+                    self.param, self.rule_state = apply_fn(
+                        self.param, grad_in, self.rule_state
+                    )
             self._m_grads.inc()
             self._committed()
             if not self.live.on:
@@ -1593,13 +1676,11 @@ class ParamServer:
             span.end("aborted")
             return
         span.mark("install")
-        with self._dev_ctx():
-            slot.param = jnp.asarray(slot.param)
-            if slot.rule_state:
-                slot.rule_state = {k: jnp.asarray(v)
-                                   for k, v in slot.rule_state.items()}
-            else:
-                slot.rule_state = self.rule.init(slot.param)
+        slot.param = self._place_param(slot.param)
+        if slot.rule_state:
+            slot.rule_state = self._place_state(slot.rule_state)
+        else:
+            slot.rule_state = self._init_state(slot.param)
         self._slots[sid] = slot
         self._m_sc_owned.set(len(self._slots))
         self._m_sc_in.inc()
@@ -1630,13 +1711,11 @@ class ParamServer:
                 "failover needs shard checkpoints")
         span.mark("restore")
         slot = _scmigrate.load_shard_state(self._ckpt_dir, sid)
-        with self._dev_ctx():
-            slot.param = jnp.asarray(slot.param)
-            if slot.rule_state:
-                slot.rule_state = {k: jnp.asarray(v)
-                                   for k, v in slot.rule_state.items()}
-            else:
-                slot.rule_state = self.rule.init(slot.param)
+        slot.param = self._place_param(slot.param)
+        if slot.rule_state:
+            slot.rule_state = self._place_state(slot.rule_state)
+        else:
+            slot.rule_state = self._init_state(slot.param)
         self._slots[sid] = slot
         self._m_sc_owned.set(len(self._slots))
         self._m_sc_adopt.inc()
@@ -1815,6 +1894,89 @@ class ParamServer:
                     live=self.live, abort=self._svc_abort(crank, gen),
                 )
 
+    # -- device exchange service (mpit_tpu.dplane, docs/DEVICE.md §4) --------
+
+    def _dp_op_counter(self, op: str):
+        c = self._m_dp_ops.get(op)
+        if c is None:
+            c = self.metrics.counter("mpit_dplane_device_ops_total",
+                                     rank=self.rank, op=op)
+            self._m_dp_ops[op] = c
+        return c
+
+    def _dplane_service(self):
+        """Drain the in-process device-exchange queue: tickets execute
+        between scheduler passes on this server's own thread, so device
+        ops serialize with wire ops under the same single-writer
+        discipline — serve-latest-committed reads stay untorn, and a
+        lockstep gang applies in the identical cross-client order on
+        either path."""
+        plane = self._plane
+        try:
+            while self.live.on:
+                ticket = plane.pop()
+                if ticket is None:
+                    # Idle pacing, not a busy scan (the IDLE_USEC lesson
+                    # from the reader dispatcher).
+                    if not (yield from aio_sleep(0.0005, live=self.live)):
+                        return
+                    continue
+                try:
+                    self._dplane_execute(ticket)
+                except BaseException as exc:
+                    # A failed op fails ITS client loudly; the service
+                    # (and every other client) keeps running.
+                    ticket.error = exc
+                finally:
+                    ticket.event.set()
+                yield EXEC
+        finally:
+            plane.close("server service exited")
+
+    def _dplane_execute(self, ticket) -> None:
+        slot = self._hbm
+        if slot is None:
+            raise RuntimeError(
+                f"device {ticket.kind} op from client {ticket.crank} "
+                "before the shard exists (INIT/seed not complete, or a "
+                "shardctl gang — the device exchange serves the static "
+                "cut only; see docs/DEVICE.md §3)")
+        kind = ticket.kind
+        name = {"grad": "GRAD", "push": "PARAM_PUSH"}.get(kind, "PARAM")
+        span = self._spans.op(name, peer=ticket.crank, side="server",
+                              rank=self.rank)
+        span.note(dplane=1)
+        if kind == "grad":
+            span.mark("apply")
+            slot.apply_grad(ticket.payload)
+            self.param, self.rule_state = slot.param, slot.rule_state
+            self._committed()
+            self._m_grads.inc()
+            self._dp_op_counter("grad").inc()
+            span.end("applied")
+        elif kind == "push":
+            span.mark("apply")
+            slot.seed(ticket.payload)
+            self.param = slot.param
+            self._committed()
+            self._dp_op_counter("push").inc()
+            span.end("applied")
+        elif kind == "pull":
+            span.mark("snapshot")
+            ticket.result = slot.snapshot_host()
+            self._m_served.inc()
+            self._dp_op_counter("pull").inc()
+            span.end("served")
+        elif kind == "pull_dev":
+            span.mark("snapshot")
+            ticket.result = slot.pull_device()
+            self._m_served.inc()
+            self._dp_op_counter("pull_dev").inc()
+            span.end("served")
+        else:
+            span.end("aborted")
+            raise ValueError(f"unknown device op kind {kind!r}")
+
     def _recv_stop(self, crank: int, gen: int = 0):
         """Await the stop signal; all clients terminal (stopped or
         evicted) => shut down I/O (reference :115-129)."""
@@ -1912,6 +2074,9 @@ class ParamServer:
             raise RuntimeError("server holds no shard yet (init not run)")
         if self._snap_host is not None and self._snap_host[0] == self._snap_version:
             host = self._snap_host[1]  # reuse the snapshot cache's d2h copy
+        elif self._hbm is not None:
+            host = self._hbm.snapshot_host()
+            self._snap_host = (self._snap_version, host)
         else:
             host = np.asarray(self.param)
             self._snap_host = (self._snap_version, host)
@@ -1946,12 +2111,26 @@ class ParamServer:
         self.grads_applied = int(meta.get("grads_applied", 0))
         self._snap_version = int(meta.get("snap_version", 0))
         self.dedup.restore(meta.get("dedup", {}))
-        with self._dev_ctx():
-            self.param = jnp.asarray(param)
+        if self._dp_cfg is not None:
+            self._hbm = _dphbm.HbmSlot(size, self.rule, self.dtype,
+                                       config=self._dp_cfg, rank=self.rank)
+            self._hbm.seed(param)
             if state:
-                self.rule_state = {k: jnp.asarray(v) for k, v in state.items()}
-            else:  # stateless rule (plain add) or legacy checkpoint
-                self.rule_state = self.rule.init(self.param)
+                self._hbm.rule_state = self._place_state(state)
+            # Version continuity across the restart (the staleness
+            # stamps ride it): resume the checkpointed stream, +1 for
+            # the seed commit — same arithmetic as the legacy path.
+            self._hbm.version = self._snap_version + 1
+            self.param = self._hbm.param
+            self.rule_state = self._hbm.rule_state
+        else:
+            with self._dev_ctx():
+                self.param = jnp.asarray(param)
+                if state:
+                    self.rule_state = {k: jnp.asarray(v)
+                                       for k, v in state.items()}
+                else:  # stateless rule (plain add) or legacy checkpoint
+                    self.rule_state = self.rule.init(self.param)
         for crank_s, info in (meta.get("clients") or {}).items():
             crank = int(crank_s)
             if crank not in self.cranks:
@@ -2046,7 +2225,25 @@ class ParamServer:
             self.sched.wait()
 
     def start(self) -> None:
-        """Run the server to completion (returns after the stop protocol)."""
+        """Run the server to completion (returns after the stop protocol).
+        With a published device plane, the plane is offered for the
+        server's whole lifetime and torn down loudly — a client blocked
+        on a dead server's plane raises, never hangs."""
+        publish = (self._dp_cfg is not None and self._dp_cfg.publish
+                   and not self._sc_join)
+        if not publish:
+            self._run()
+            return
+        self._plane = _dpexchange.DevicePlane(
+            self.rank, _dpexchange.backend_fingerprint())
+        _dpexchange.publish(self.rank, self._plane, self._dp_cfg.namespace)
+        try:
+            self._run()
+        finally:
+            _dpexchange.withdraw(self.rank, self._dp_cfg.namespace)
+            self._plane.close("server stopped")
+
+    def _run(self) -> None:
         if self._sc_join:
             # Joiner (§9.1): spawned into a live gang by the controller.
             # No phase-1 rendezvous — nobody owes us an INIT.  Every
@@ -2106,6 +2303,10 @@ class ParamServer:
             )
         for crank in self.cranks:
             self._spawn_services(crank)
+        if self._plane is not None:
+            # Device exchange (mpit_tpu.dplane): ONE service task drains
+            # the in-process ticket queue for every same-backend client.
+            self.sched.spawn(self._dplane_service(), name="dplane_service")
         if self.readers:
             # Serving tier: ONE dispatcher task for every reader —
             # readers attach lazily, any time mid-run, and the

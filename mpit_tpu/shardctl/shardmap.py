@@ -92,6 +92,25 @@ class ShardMap:
             for i, (shard, rank) in enumerate(zip(shards, ranks))
         ])
 
+    @classmethod
+    def from_shards(cls, shards, server_ranks: Sequence[int],
+                    *, version: int = 0) -> "ShardMap":
+        """A map over an explicit pre-cut shard list (one owner per
+        shard, in order) — the entry point for externally computed
+        layouts, e.g. the dplane partition engine's segment-aligned
+        cuts (:func:`mpit_tpu.dplane.partition.plan_shard_map`).  The
+        constructor's tiling validation still applies."""
+        shards = list(shards)
+        ranks = list(server_ranks)
+        if len(shards) != len(ranks):
+            raise ValueError(
+                f"{len(shards)} shards for {len(ranks)} owners")
+        plong = max(s.end for s in shards)
+        return cls(version, plong, [
+            ShardEntry(i, shard, rank)
+            for i, (shard, rank) in enumerate(zip(shards, ranks))
+        ])
+
     def moved(self, shard_id: int, new_owner: int) -> "ShardMap":
         """The same cut with ``shard_id`` reassigned; version + 1."""
         if shard_id not in self._by_id:

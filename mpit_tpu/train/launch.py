@@ -131,6 +131,17 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     elastic_spares=1,
     elastic_grace_s=5.0,
     elastic_shards_per_server=2,
+    # Device-resident data plane (mpit_tpu.dplane; docs/DEVICE.md):
+    # servers hold shard + optimizer state as (mesh-sharded) HBM arrays
+    # with donated jitted applies and publish an in-process device
+    # exchange; workers route through an ExchangeClient that takes the
+    # device path to same-backend servers and falls back to the wire
+    # (codecs/retry/dedup intact) everywhere else — in the process-mode
+    # gang every pair crosses a process boundary, so the win there is
+    # the server-side slot (no per-apply reallocation, shared snapshot
+    # caches); the np=1 path and in-process harnesses get the full
+    # device exchange.
+    dplane=0,
 )
 
 
@@ -180,6 +191,14 @@ def assign_roles(
             f"clients from size={size}, master_freq={master_freq}"
         )
     return sranks, cranks, tester_rank
+
+
+def _dplane_cfg(cfg: Config):
+    """PlaneConfig for --dplane servers: mesh over the default devices
+    when more than one exists, single-device HBM placement otherwise."""
+    from mpit_tpu.dplane import PlaneConfig
+
+    return PlaneConfig.auto(namespace=str(cfg.get("namespace", "") or ""))
 
 
 def server_rule_for(cfg: Config) -> Any:
@@ -461,6 +480,7 @@ def run_rank(
             reader_ranks=reader_ranks or None,
             serve=serve_cfg_for(cfg) if reader_ranks else None,
             preempt=_maybe_preemption(cfg),
+            dplane=(_dplane_cfg(cfg) if int(cfg.get("dplane", 0)) else None),
         )
         if bool(cfg.get("resume", False)):
             import pathlib
@@ -497,6 +517,10 @@ def run_rank(
             int(cfg.get("elastic_shards_per_server", 2) or 1)
             if elastic_on else 1),
     )
+    if int(cfg.get("dplane", 0)):
+        from mpit_tpu.dplane import ExchangeClient
+
+        pclient = ExchangeClient(pclient)
     trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
     log.info("worker with servers %s", sranks)
     return {"role": "worker", **trainer.run()}

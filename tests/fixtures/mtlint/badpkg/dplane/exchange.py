@@ -1,0 +1,34 @@
+"""Seeded dplane hot-path violations (mtlint fixture — parsed, never
+imported): host transfers inside device-resident apply/exchange paths."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def apply_update(param, grad, state):
+    host = np.asarray(grad)  # MT-J311: host materialization on apply path
+    return param + jnp.asarray(host), state
+
+
+def pull_exchange(slot):
+    out = slot.param
+    out.block_until_ready()  # MT-J312: device barrier on the hot path
+    return out
+
+
+def sync_round(plane, update):
+    loss = update[0].item()  # MT-J311: scalar host pull per op
+    jax.device_get(update)  # MT-J311: whole-array host pull
+    return loss
+
+
+def snapshot_host(slot):
+    # Exempt by name: the one sanctioned d2h (per-version cache).
+    return np.asarray(slot.param)
+
+
+def timing_probe(x):
+    # Exempt by name: timing code may fence.
+    x.block_until_ready()
+    return x

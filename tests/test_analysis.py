@@ -164,6 +164,22 @@ class TestSeededViolations:
         assert "rogue_phase" in hits[0].message
         assert "OBSERVABILITY.md" in hits[0].message
 
+    def test_dplane_host_transfer_detected(self, bad):
+        # Seeds: np.asarray in apply_update, .item() + device_get in
+        # sync_round — and nothing from the name-exempted
+        # snapshot_host/timing_probe bodies.
+        hits = bad.get("MT-J311", [])
+        assert {(f.path, f.line) for f in hits} == {
+            ("dplane/exchange.py", 10),
+            ("dplane/exchange.py", 21),
+            ("dplane/exchange.py", 22)}
+
+    def test_dplane_device_barrier_detected(self, bad):
+        hits = bad.get("MT-J312", [])
+        assert [(f.path, f.line) for f in hits] == [
+            ("dplane/exchange.py", 16)]
+        assert "block_until_ready" in hits[0].message
+
     def test_nonbinary_pairs_exempt_from_role_model(self, bad):
         # The pairing table is what exempts controller / server<->server
         # tags from MT-P101/P102 — the badpkg table is all-binary, so

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Device-loop smoke — the CI job behind `device-loop-smoke` (ci.yml).
+
+Runs the same 2-server / 2-client shardctl gang twice on the in-process
+router under a forced-8-device CPU mesh
+(``--xla_force_host_platform_device_count``): once on the legacy host
+path with the static version-0 map, once with the device-resident data
+plane on (mesh-sharded HBM slots, donated jitted applies) AND one live
+shard migration mid-run.  Asserts:
+
+1. final params are **bitwise equal** across the two runs — the dplane
+   placement + donation + migration leave no trace in the math;
+2. the device plane was really load-bearing: slots sharded over the
+   8-device mesh, donated applies counted, one map flip + NACK drain;
+3. the obs trace from the dplane run validates (balanced span pairs)
+   and carries MIGRATE spans from both sides of the handoff.
+
+Exit code 0 on success; any assertion or hang surfaces as a non-zero
+exit for CI.  Usage: ``python tools/device_smoke.py [trace.json]``.
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRACE = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mpit_dplane_trace.json"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mpit_tpu.utils.platform import ensure_cpu_device_headroom  # noqa: E402
+
+ensure_cpu_device_headroom(8)
+
+import numpy as np  # noqa: E402
+
+from mpit_tpu.comm.local import LocalRouter  # noqa: E402
+from mpit_tpu.dplane import PlaneConfig  # noqa: E402
+from mpit_tpu.ft import FTConfig  # noqa: E402
+from mpit_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mpit_tpu.ps import ParamClient, ParamServer  # noqa: E402
+from mpit_tpu.shardctl import ShardController  # noqa: E402
+from mpit_tpu.utils.platform import default_devices  # noqa: E402
+
+FT = FTConfig(op_deadline_s=1.0, max_retries=8,
+              backoff_base_s=0.01, backoff_cap_s=0.05)
+SIZE = 8192
+ROUNDS = 8
+MIGRATE_AT = 4
+
+
+def run_gang(dplane: bool, migrate: bool):
+    router = LocalRouter(5)
+    sranks, cranks, ctl_rank = [0, 1], [2, 3], 4
+    cfg = (PlaneConfig(mesh=make_mesh(default_devices(), dp=1))
+           if dplane else None)
+    servers = [ParamServer(r, cranks, router.endpoint(r), rule="adam",
+                           ft=FT, controller_rank=ctl_rank, dplane=cfg)
+               for r in sranks]
+    threads = [threading.Thread(target=s.start, daemon=True)
+               for s in servers]
+    for t in threads:
+        t.start()
+    ctl = ShardController(ctl_rank, router.endpoint(ctl_rank), sranks,
+                          cranks)
+    clients = [ParamClient(r, sranks, router.endpoint(r),
+                           seed_servers=(r == cranks[0]), ft=FT,
+                           shardctl=True, controller_rank=ctl_rank)
+               for r in cranks]
+    rng = np.random.default_rng(11)
+    w0 = rng.normal(size=SIZE).astype(np.float32)
+    gtab = rng.normal(size=(2, ROUNDS, SIZE)).astype(np.float32)
+    params = [w0.copy(), np.zeros(SIZE, np.float32)]
+    starters = []
+    for c, p in zip(clients, params):
+        starters.append(threading.Thread(
+            target=c.start, args=(p, np.zeros(SIZE, np.float32)),
+            daemon=True))
+        starters[-1].start()
+    for t in starters:
+        t.join(30)
+        assert not t.is_alive(), "client start hung"
+    ctl.pump()
+    assert ctl.smap is not None, "controller never learned the map"
+    for r in range(ROUNDS):
+        if migrate and r == MIGRATE_AT:
+            assert ctl.migrate(1, 0), "migration refused"
+        for i, c in enumerate(clients):
+            c.grad[:] = gtab[i, r]
+            c.async_send_grad()
+            c.wait()
+    clients[0].async_recv_param()
+    clients[0].wait()
+    final = clients[0].param.copy()
+    for c in clients:
+        c.stop()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "server stop-protocol hung"
+    ctl.pump()
+    assert ctl.done, "controller missed client STOPs"
+    nacks = sum(int(c._m_nacks.value) for c in clients)
+    return final, servers, nacks, ctl
+
+
+def main() -> int:
+    host, _, _, _ = run_gang(dplane=False, migrate=False)
+
+    # The dplane leg exports a trace (obs enabled just for this run).
+    os.environ["MPIT_OBS_TRACE"] = TRACE
+    from mpit_tpu import obs
+
+    obs.configure(enabled=True)
+    device, servers, nacks, ctl = run_gang(dplane=True, migrate=True)
+
+    np.testing.assert_array_equal(host, device)
+    print(f"bitwise OK over {ROUNDS} rounds x 2 clients "
+          f"(dplane + migration at round {MIGRATE_AT})")
+
+    # Device plane load-bearing: the migrated-to slot is mesh-sharded
+    # over all 8 devices, and the donated apply path ran on it.
+    assert servers[0].owned_shards == [0, 1], servers[0].owned_shards
+    sharding = servers[0].shard_param(0).sharding
+    ndev = len(sharding.device_set)
+    assert ndev == 8, f"slot not mesh-sharded: {ndev} device(s)"
+    # 2 clients x 2 shards per round: every grad splits across the cut.
+    applied = sum(s.grads_applied for s in servers)
+    assert applied == 4 * ROUNDS, applied
+    assert ctl.smap.version == 1, ctl.smap.version
+    assert nacks > 0, "no op drained through NACK_MAP"
+    print(f"device plane exercised: slots over {ndev} devices, "
+          f"{applied} donated applies, map v{ctl.smap.version}, "
+          f"{nacks} NACK(s)")
+
+    from mpit_tpu.obs import maybe_merge_rank_traces, maybe_write_rank_trace
+    from mpit_tpu.obs.trace import validate_trace
+
+    maybe_write_rank_trace(0, role="smoke")
+    merged = maybe_merge_rank_traces()
+    assert merged, "trace export produced no file"
+    stats = validate_trace(merged)
+    print(f"trace OK: {stats}")
+    import json
+
+    with open(merged) as fh:
+        events = json.load(fh)["traceEvents"]
+    migrate_spans = [e for e in events
+                     if e.get("name") == "MIGRATE" and e.get("ph") == "B"]
+    directions = {e.get("args", {}).get("direction")
+                  for e in migrate_spans}
+    assert {"out", "in"} <= directions, (
+        f"MIGRATE spans missing a side: {directions}")
+    print(f"MIGRATE spans from both sides: {len(migrate_spans)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
