@@ -144,6 +144,19 @@ READER_BUDGET_MB = float(os.environ.get("MPIT_BENCH_READER_BUDGET_MB", "8"))
 # exactly the quantity an autoscaler trades against preemption risk.
 ELASTIC_SWEEP = os.environ.get("MPIT_BENCH_ELASTIC", "") not in ("", "0")
 ELASTIC_MBS = float(os.environ.get("MPIT_BENCH_ELASTIC_MBS", "300"))
+# MPIT_BENCH_AUTOSCALE=1: the closed-loop A/B (ISSUE 11,
+# docs/OPERATIONS.md §3) — the 'bench' scenario's bursty leg (shaped
+# reader load + gradient bursts, mpit_tpu.ft.traffic) runs twice on the
+# in-process elastic gang under the BENCH_r11 member-capacity throttle:
+# once as a static gang (launch membership, no loop), once with the
+# SLO-driven autoscaler attached and nobody calling /scale.  Rows
+# record completed logical MB/s over the scenario plus the decision
+# counts, tagged metric=ps_autoscale_closed_loop — they measure what
+# the loop is worth under shaped load, never the wire record, so they
+# are excluded from the codec=none baseline gate like the skew and
+# elastic rows.  Both legs must end bitwise-identical (asserted
+# in-bench: the loop must not cost correctness to buy throughput).
+AUTOSCALE_SWEEP = os.environ.get("MPIT_BENCH_AUTOSCALE", "") not in ("", "0")
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
 # (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
@@ -282,6 +295,79 @@ def bench_elastic() -> list:
              "the 1-server legs — server CPU was not the bottleneck at "
              "this payload/host; prefer MPIT_BENCH_MB large enough that "
              "apply+encode dominates")
+    return rows
+
+
+def bench_autoscale() -> list:
+    """The closed-loop A/B (MPIT_BENCH_AUTOSCALE): static vs
+    autoscaler-on under the 'bench' scenario's bursty leg, both on the
+    member-capacity throttle.  Reuses the soak harness's gang driver
+    (tools/autoscale_soak.py) so the bench and the CI smoke measure
+    the same machinery."""
+    import importlib.util
+
+    import numpy as np
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "autoscale_soak.py")
+    spec = importlib.util.spec_from_file_location("autoscale_soak", path)
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+
+    import tempfile
+
+    from mpit_tpu.ft.traffic import Scenario
+    from mpit_tpu.obs import configure
+
+    scenario = Scenario.builtin("bench")
+    os.environ.setdefault("MPIT_OBS_FLIGHT", tempfile.mkdtemp(
+        prefix="mpit_bench_autoscale_"))
+    rows, finals = [], {}
+    try:
+        for label, on in (("static", False), ("autoscaled", True)):
+            configure(enabled=True, reset=True)
+            with tempfile.TemporaryDirectory() as ckpt:
+                res = soak.run_scenario(scenario, autoscale=on,
+                                        chaos=True, ckpt_dir=ckpt)
+            if res["errors"]:
+                raise RuntimeError(f"autoscale {label} leg: {res['errors']}")
+            finals[label] = res["final"]
+            ops = res["grad_rounds"] + res["reads_done"]
+            mbs = ops * res["size"] * 4 / res["elapsed"] / 2 ** 20
+            scaler = res["scaler"]
+            row = {
+                "metric": "ps_autoscale_closed_loop",
+                "value": round(mbs, 1),
+                "unit": "MB/s",
+                "phase": label,
+                "autoscale": int(on),
+                "grad_rounds": res["grad_rounds"],
+                "reads_done": res["reads_done"],
+                "elapsed_s": round(res["elapsed"], 2),
+                "member_capacity_mbs": soak.MEMBER_MBS,
+                "p99_target_ms": soak.P99_TARGET_MS,
+            }
+            if scaler is not None:
+                row["scale_ups"] = scaler.ups
+                row["scale_downs"] = scaler.downs
+                row["operator_calls"] = scaler.operator_calls
+            rows.append(row)
+            _log(f"[autoscale] {label}: {mbs:.1f} MB/s logical "
+                 f"({res['grad_rounds']} rounds + {res['reads_done']} "
+                 f"reads in {res['elapsed']:.1f}s)")
+    finally:
+        configure(enabled=None, reset=True)
+    # The loop must not cost correctness to buy throughput.
+    np.testing.assert_array_equal(finals["static"], finals["autoscaled"])
+    by = {r["phase"]: r["value"] for r in rows}
+    ratio = by["autoscaled"] / max(by["static"], 1e-9)
+    _log(f"[autoscale] closed loop vs static: {by['autoscaled']:.1f} vs "
+         f"{by['static']:.1f} MB/s ({ratio:.2f}x), bitwise-equal finals")
+    if ratio <= 1.0:
+        _log("[autoscale] WARNING: the closed loop did not beat the "
+             "static gang — the burst never saturated the launch "
+             "membership on this host (capacity model mistuned?)")
     return rows
 
 
@@ -1004,6 +1090,12 @@ def main():
         # The shrink/grow sweep: capacity at each size of a 1 -> 2 -> 1
         # membership walk; rows never join the codec=none gate.
         results.extend(bench_elastic())
+    if AUTOSCALE_SWEEP and MODE in ("shm", "both"):
+        # The closed-loop A/B: static vs autoscaled under the bursty
+        # scenario leg (in-process gang, member-capacity throttle);
+        # rows never join the codec=none gate.  Runs LAST: it flips
+        # the parent's obs registry on and off around itself.
+        results.extend(bench_autoscale())
     for r in results:
         print(json.dumps(r))
     if BASELINE > 0:

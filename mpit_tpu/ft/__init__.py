@@ -35,6 +35,7 @@ from mpit_tpu.ft.leases import (
     LeaseRegistry,
 )
 from mpit_tpu.ft.retry import RetryExhausted, RetryPolicy
+from mpit_tpu.ft.traffic import Scenario, TrafficEvent, TrafficPhase
 from mpit_tpu.ft.wire import (
     ACK_TIMING_WORDS,
     FLAG_FRAMED,
@@ -67,6 +68,7 @@ __all__ = [
     "PreemptionNotice", "ElasticDirectory",
     "LeaseRegistry", "ACTIVE", "EVICTED", "STOPPED", "RETIRED",
     "RetryPolicy", "RetryExhausted",
+    "Scenario", "TrafficPhase", "TrafficEvent",
     "HDR_BYTES", "HDR_STALE_BYTES",
     "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_READONLY", "FLAG_STALENESS",
     "FLAG_TIMING",

@@ -15,7 +15,15 @@ paths dump the ring to disk:
 - the scheduler watchdog dumps when a non-empty task queue accumulates
   ``MPIT_OBS_STALL_S`` seconds of idle backoff without completing a
   single task — a stuck gang produces a task table + recent-event dump
-  instead of nothing.
+  instead of nothing;
+- the autoscaler (shardctl/autoscale.py) dumps on every **executed
+  scale action** (``autoscale_up`` / ``autoscale_down``) and once per
+  **SLO-breach episode that outlives the settle window**
+  (``slo_breach``) — the dump's ``extra`` carries the full decision
+  record and the triggering telemetry window, so a mis-scaled gang
+  produces a postmortem naming the signal that drove it
+  (:func:`validate_dump` checks that shape; docs/OPERATIONS.md walks a
+  dump).
 
 Dumps are JSON (:func:`FlightRecorder.dump` schema in
 docs/OBSERVABILITY.md): rank/role/pid, the dump reason, the ring's
@@ -210,6 +218,27 @@ def validate_dump(path_or_obj) -> Dict[str, object]:
             raise ValueError("tasks is not a list of [name, state] pairs")
     if not isinstance(obj["metrics"], dict):
         raise ValueError("metrics snapshot is not a dict")
+    reason = str(obj.get("reason", ""))
+    if reason.startswith("autoscale_") or reason == "slo_breach":
+        # Autoscale postmortems must carry the decision that drove them
+        # and the telemetry window that justified it — a dump without
+        # them names no signal and explains nothing.
+        extra = obj.get("extra")
+        if not isinstance(extra, dict):
+            raise ValueError(f"{reason} dump has no extra payload")
+        decision = extra.get("decision")
+        if not isinstance(decision, dict) or "action" not in decision \
+                or "reason" not in decision:
+            raise ValueError(
+                f"{reason} dump extra.decision must be a dict with "
+                "action + reason")
+        if "window" not in extra:
+            raise ValueError(
+                f"{reason} dump extra must carry the telemetry window "
+                "(window key; null allowed for a no-data decision)")
+        if reason == "slo_breach" and "breach_for_s" not in extra:
+            raise ValueError(
+                "slo_breach dump extra must carry breach_for_s")
     return {
         "reason": obj["reason"],
         "rank": obj.get("rank"),

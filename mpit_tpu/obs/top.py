@@ -114,6 +114,37 @@ def hist_quantile(samples, name: str, q: float) -> Optional[float]:
     return best
 
 
+def hist_quantile_between(prev, cur, name: str, q: float) -> Optional[float]:
+    """Quantile of a histogram over the *window* between two sample
+    snapshots: cumulative ``_bucket{le=}`` counts are differenced per
+    bound (pooled across label sets) before the rank walk, so the
+    estimate describes what happened since ``prev`` — the sliding-window
+    read the autoscaler acts on — rather than the run's whole history.
+    None when nothing was observed in the window."""
+    per_le: Dict[float, float] = {}
+    for samples, sign in ((cur, 1.0), (prev, -1.0)):
+        for n, labels, value in samples:
+            if n != name + "_bucket":
+                continue
+            le = labels.get("le", "")
+            bound = float("inf") if le == "+Inf" else float(le)
+            per_le[bound] = per_le.get(bound, 0.0) + sign * value
+    total = (metric_sum(cur, name + "_count")
+             - metric_sum(prev, name + "_count"))
+    if not per_le or total <= 0:
+        return None
+    target = q * total
+    best = None
+    for bound in sorted(per_le):
+        if per_le[bound] >= target:
+            best = bound
+            break
+    if best is None or best == float("inf"):
+        finite = [b for b in per_le if b != float("inf")]
+        best = max(finite) if finite else None
+    return best
+
+
 def _get(url: str, timeout: float) -> bytes:
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read()
@@ -142,8 +173,11 @@ def collect(host: str, base: int, nranks: int,
 
 
 def _rank_row(rank: int, sample: Optional[dict],
-              prev: Optional[dict], dt: Optional[float]) -> Dict[str, object]:
-    """One rank's table row (also the --json record)."""
+              prev: Optional[dict], dt: Optional[float],
+              p99_target_ms: Optional[float] = None) -> Dict[str, object]:
+    """One rank's table row (also the --json record).
+    ``p99_target_ms`` (from the controller's autoscale SLO, when one is
+    running) turns the p99 column into a vs-target verdict."""
     if sample is None:
         return {"rank": rank, "up": False}
     m = sample["metrics"]
@@ -177,6 +211,32 @@ def _rank_row(rank: int, sample: Optional[dict],
         "gang_size": int(metric_sum(m, "mpit_gang_size", role="server")),
         "inflight": len(status.get("inflight_ops") or []),
     }
+    # SLO columns (ISSUE 11): BUSY-reply ratio (admission rejections
+    # over ops — windowed against the previous refresh when one exists)
+    # and the per-rank p99-vs-target verdict read off the autoscaler's
+    # published SLO.
+    busy_all = (metric_sum(m, "mpit_ps_busy_replies_total")
+                + metric_sum(m, "mpit_shardctl_busy_replies_total"))
+    if prev is not None:
+        pm = prev["metrics"]
+        d_busy = busy_all - (metric_sum(pm, "mpit_ps_busy_replies_total")
+                             + metric_sum(pm,
+                                          "mpit_shardctl_busy_replies_total"))
+        d_ops = ops - (metric_sum(pm, "mpit_ps_grads_applied_total")
+                       + metric_sum(pm, "mpit_ps_params_served_total"))
+        denom = d_busy + max(d_ops, 0.0)
+        row["busy_ratio"] = (d_busy / denom) if denom > 0 else 0.0
+        row["p99_s"] = hist_quantile_between(pm, m, "mpit_ps_op_seconds",
+                                             0.99) or row["p99_s"]
+    else:
+        denom = busy_all + ops
+        row["busy_ratio"] = (busy_all / denom) if denom > 0 else 0.0
+    row["p99_target_ms"] = p99_target_ms
+    p99 = row.get("p99_s")
+    if p99_target_ms and p99 is not None:
+        row["slo"] = "hot" if p99 * 1000.0 > p99_target_ms else "ok"
+    else:
+        row["slo"] = None
     if prev is not None and dt and dt > 0:
         prev_ops = (metric_sum(prev["metrics"], "mpit_ps_grads_applied_total")
                     + metric_sum(prev["metrics"],
@@ -185,7 +245,40 @@ def _rank_row(rank: int, sample: Optional[dict],
     return row
 
 
-_COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "sendq", "conns",
+def autoscale_status(samples: Dict[int, Optional[dict]]) -> Optional[dict]:
+    """The gang's autoscale section, from whichever rank runs the
+    controller (None when no autoscaler is attached) — the source of
+    the status line and the --json ``autoscale`` field."""
+    for sample in samples.values():
+        if sample is None:
+            continue
+        section = (sample["status"].get("controller") or {}).get("autoscale")
+        if section:
+            return section
+    return None
+
+
+def render_autoscale_line(section: Optional[dict]) -> str:
+    """One status line: last decision, cooldown remaining, SLO targets
+    (the gang-level half of the SLO columns)."""
+    if not section:
+        return "autoscale: (not running)"
+    last = section.get("last") or {}
+    slo = section.get("slo") or {}
+    counts = section.get("decisions") or {}
+    targets = " ".join(f"{k}<={v:g}" for k, v in sorted(slo.items()))
+    action = last.get("action", "-")
+    reason = last.get("reason", "-")
+    return (f"autoscale: last={action}({reason}) "
+            f"cooldown={section.get('cooldown_s', 0):.1f}s "
+            f"up/down/hold={counts.get('up', 0)}/{counts.get('down', 0)}"
+            f"/{counts.get('hold', 0)} "
+            f"operator_calls={section.get('operator_calls', 0)}"
+            + (f" slo[{targets}]" if targets else ""))
+
+
+_COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "slo", "busy%",
+            "sendq", "conns",
             "busy", "stale", "retry", "evict", "shards", "busy_s", "mapv",
             "gang", "infl")
 
@@ -197,11 +290,17 @@ def render_table(rows: List[Dict[str, object]]) -> str:
         stale = row["staleness_mean"]
         ops_s = row["ops_per_s"]
         p99 = row.get("p99_s")
+        busy_ratio = row.get("busy_ratio")
         return [
             str(row["rank"]), str(row["role"]) or "?",
             str(row["ops_total"]),
             f"{ops_s:.1f}" if ops_s is not None else "-",
             f"{p99 * 1000.0:.2f}" if p99 is not None else "-",
+            # p99 vs the autoscaler's published target: HOT above it,
+            # ok within, '-' when no SLO is running on this gang.
+            ("HOT" if row["slo"] == "hot" else "ok")
+            if row.get("slo") else "-",
+            f"{busy_ratio * 100.0:.0f}" if busy_ratio else "-",
             str(row["send_queue"]) if row.get("send_queue") else "-",
             str(row["conns"]) if row.get("conns") else "-",
             str(row["busy"]) if row.get("busy") else "-",
@@ -270,13 +369,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             now = time.monotonic()
             samples = collect(args.host, base, args.np)
             dt = (now - prev_t) if prev_t is not None else None
-            rows = [_rank_row(r, samples[r], prev.get(r), dt)
+            autoscale = autoscale_status(samples)
+            target = (autoscale or {}).get("slo", {}).get("p99_ms")
+            rows = [_rank_row(r, samples[r], prev.get(r), dt,
+                              p99_target_ms=target)
                     for r in range(args.np)]
             up = sum(1 for r in rows if r.get("up"))
             if args.json:
-                print(json.dumps({"ranks": rows}))
+                print(json.dumps({"ranks": rows, "autoscale": autoscale}))
             else:
                 print(render_table(rows))
+                print(render_autoscale_line(autoscale))
                 print(f"-- {up}/{args.np} rank(s) up; refresh {i}"
                       + (f"/{args.iters}" if args.iters else "") + " --")
             sys.stdout.flush()

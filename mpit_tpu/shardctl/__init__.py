@@ -24,6 +24,12 @@ object and threads it through ps/comm/ft/obs/train:
   load-aware :class:`RebalancePolicy` consuming per-shard busy reports
   (PR 4's obs instruments), and the :class:`ShardController` that
   executes migrations and failovers and distributes committed maps.
+- :mod:`autoscale` — the closed loop (docs/OPERATIONS.md): an
+  SLO-driven :class:`AutoscalePolicy` (hysteresis bands, cooldown,
+  flap-suppression budget, operator precedence) over windowed gang
+  telemetry read through the obs/top path, actuated by an
+  :class:`Autoscaler` through the controller's existing §9 scale
+  verbs, with every decision audited and flight-recorded.
 
 Correctness invariants (tested in tests/test_shardctl.py): live
 migration and lease-expiry failover both leave final params **bitwise
@@ -32,6 +38,16 @@ plans — the shard-scoped dedup state travels with the shard, so a
 retried op admits at-most-once across owners.
 """
 
+from mpit_tpu.shardctl.autoscale import (
+    AutoscaleConfig,
+    AutoscalePolicy,
+    Autoscaler,
+    Decision,
+    HttpSampler,
+    RegistrySampler,
+    SLOConfig,
+    TelemetryWindow,
+)
 from mpit_tpu.shardctl.controller import ShardController
 from mpit_tpu.shardctl.migrate import (
     SC_DEADLINE_S,
@@ -57,6 +73,8 @@ from mpit_tpu.shardctl.wire import (
 __all__ = [
     "ShardController", "ShardSlot", "ShardMap", "ShardEntry",
     "RebalancePolicy", "ShardLoad",
+    "SLOConfig", "AutoscaleConfig", "AutoscalePolicy", "Autoscaler",
+    "Decision", "TelemetryWindow", "RegistrySampler", "HttpSampler",
     "save_shard_state", "load_shard_state",
     "SC_DEADLINE_S", "SC_HDR_BYTES", "FLAG_SHARDCTL",
     "OK", "NACK_MAP", "BUSY",

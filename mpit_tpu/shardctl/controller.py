@@ -136,6 +136,10 @@ class ShardController:
         #: operator requests from the statusd /scale route (HTTP thread
         #: producers, pump() the only consumer).
         self._scale_requests: Deque[Dict[str, str]] = deque()
+        #: the closed-loop autoscaler (shardctl/autoscale.py), attached
+        #: via attach_autoscaler(); pump() drives it after operator
+        #: requests — the manual route always has precedence.
+        self.autoscaler = None
         self.metrics = registry_or_local()
         _m, _r = self.metrics, rank
         self._m_beats = _m.counter("mpit_shardctl_beats_seen_total", rank=_r)
@@ -163,9 +167,21 @@ class ShardController:
         self._m_gang_srv.set(len(self._live_servers()))
         self._m_gang_cli.set(len(self.cranks) - len(self._stopped))
 
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Bind an :class:`~mpit_tpu.shardctl.autoscale.Autoscaler`:
+        pump() drives its cadence, /status grows its section, and
+        operator /scale requests suppress it (precedence, §9.5)."""
+        self.autoscaler = autoscaler
+
     def _status_section(self) -> Dict[str, object]:
         """The controller's /status section (statusd thread: plain
         attribute reads only)."""
+        if self.autoscaler is not None:
+            return {**self._status_base(),
+                    "autoscale": self.autoscaler.status_section()}
+        return self._status_base()
+
+    def _status_base(self) -> Dict[str, object]:
         return {
             "role": "controller",
             "rank": self.rank,
@@ -194,6 +210,10 @@ class ShardController:
             return {"error": "op must be 'up' or 'down'"}
         if op == "down" and "rank" not in params:
             return {"error": "op=down needs rank=<server>"}
+        if self.autoscaler is not None:
+            # Operator precedence: the loop stands down while a human
+            # is driving (plain attribute writes — HTTP thread safe).
+            self.autoscaler.note_operator()
         self._scale_requests.append(dict(params))
         return {"queued": dict(params),
                 "membership_epoch": self.membership_epoch}
@@ -563,6 +583,8 @@ class ShardController:
             self._on_preempt(rank, grace_ms)
         self.check_leases()
         self._drain_scale_requests()
+        if self.autoscaler is not None and not self.done:
+            self.autoscaler.pump()
         self.maybe_rebalance()
         self._update_gang_gauges()
 

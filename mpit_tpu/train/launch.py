@@ -131,6 +131,25 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     elastic_spares=1,
     elastic_grace_s=5.0,
     elastic_shards_per_server=2,
+    # Closed-loop autoscaling (mpit_tpu.shardctl.autoscale;
+    # docs/OPERATIONS.md): --autoscale implies --elastic and attaches
+    # an SLO-driven policy engine to the controller, which samples the
+    # gang through every rank's statusd endpoint (requires
+    # MPIT_OBS_HTTP — the same read path `mpit top` uses) and drives
+    # the §9 scale verbs automatically; the operator /scale route keeps
+    # precedence.  Targets: 0 disables a signal.  The policy's
+    # hysteresis/cooldown/flap knobs take the AutoscaleConfig defaults
+    # unless overridden here.
+    autoscale=False,
+    autoscale_p99_ms=0.0,
+    autoscale_busy_ratio=0.0,
+    autoscale_staleness=0.0,
+    autoscale_sendq=0.0,
+    autoscale_window_s=2.0,
+    autoscale_cooldown_s=20.0,
+    autoscale_flap_budget=3,
+    autoscale_min_servers=1,
+    autoscale_max_servers=0,  # 0 = every provisioned server slot
     # Device-resident data plane (mpit_tpu.dplane; docs/DEVICE.md):
     # servers hold shard + optimizer state as (mesh-sharded) HBM arrays
     # with donated jitted applies and publish an in-process device
@@ -277,6 +296,39 @@ def run_reader(rank: int, sranks: List[int], cfg: Config,
         "retries": rc.retries,
         "versions": {str(k): v for k, v in rc.versions.items()},
     }
+
+
+def _autoscaler_for(cfg: Config, ctl, size: int):
+    """The controller rank's Autoscaler under --autoscale: SLO targets
+    from the launch knobs, telemetry pooled over every rank's statusd
+    endpoint (HttpSampler — launch_processes validated MPIT_OBS_HTTP)."""
+    from mpit_tpu.obs.statusd import base_port
+    from mpit_tpu.shardctl.autoscale import (
+        AutoscaleConfig,
+        Autoscaler,
+        HttpSampler,
+        SLOConfig,
+    )
+
+    slo = SLOConfig(
+        p99_ms=float(cfg.get("autoscale_p99_ms", 0) or 0),
+        busy_ratio=float(cfg.get("autoscale_busy_ratio", 0) or 0),
+        staleness=float(cfg.get("autoscale_staleness", 0) or 0),
+        send_queue=float(cfg.get("autoscale_sendq", 0) or 0),
+    )
+    max_servers = int(cfg.get("autoscale_max_servers", 0) or 0)
+    if max_servers <= 0:
+        max_servers = len(ctl.sranks) + len(ctl.spares)
+    acfg = AutoscaleConfig(
+        slo=slo,
+        window_s=float(cfg.get("autoscale_window_s", 2.0)),
+        cooldown_s=float(cfg.get("autoscale_cooldown_s", 20.0)),
+        flap_budget=int(cfg.get("autoscale_flap_budget", 3)),
+        min_servers=int(cfg.get("autoscale_min_servers", 1)),
+        max_servers=max_servers,
+    )
+    sampler = HttpSampler(base_port(), nranks=size)
+    return Autoscaler(ctl, acfg, sampler=sampler)
 
 
 def _maybe_preemption(cfg: Config):
@@ -436,7 +488,23 @@ def run_rank(
                 return _scale_down(r)
 
             ctl.scale_down = scale_down_marked
+        if bool(cfg.get("autoscale", False)):
+            ctl.attach_autoscaler(_autoscaler_for(cfg, ctl, size))
         ctl.serve()
+        if ctl.autoscaler is not None:
+            return {
+                "role": "controller",
+                "map_version": getattr(ctl.smap, "version", None),
+                "rebalances": int(ctl._m_rebal.value),
+                "failovers": int(ctl._m_fail.value),
+                "membership_epoch": ctl.membership_epoch,
+                "elastic_events": {
+                    "up": int(ctl._m_up.value),
+                    "down": int(ctl._m_down.value),
+                    "preempt": int(ctl._m_pre.value),
+                },
+                "autoscale": ctl.autoscaler.status_section(),
+            }
         return {
             "role": "controller",
             "map_version": getattr(ctl.smap, "version", None),
@@ -627,6 +695,26 @@ def launch_processes(cfg: Config, timeout: float = 3600.0) -> Dict[int, Dict[str
             f"unknown optimizer {cfg.opt!r}; have {MnistTrainer.KNOWN_OPTS}"
         )
     restarts = int(cfg.get("supervise", 0))
+    if bool(cfg.get("autoscale", False)):
+        # --autoscale = --elastic + the closed loop on the controller.
+        # The loop's telemetry rides the statusd endpoints, so the gang
+        # must be serving them; failing here beats a controller that
+        # silently samples nothing and never scales.
+        from mpit_tpu.obs.statusd import base_port as _obs_base_port
+
+        if _obs_base_port() is None:
+            raise ValueError(
+                "--autoscale needs MPIT_OBS_HTTP=<base_port>: the "
+                "autoscaler samples the gang through the statusd "
+                "endpoints (the same read path `mpit top` uses)")
+        if not any(float(cfg.get(k, 0) or 0) > 0 for k in
+                   ("autoscale_p99_ms", "autoscale_busy_ratio",
+                    "autoscale_staleness", "autoscale_sendq")):
+            raise ValueError(
+                "--autoscale needs at least one SLO target "
+                "(--autoscale_p99_ms / _busy_ratio / _staleness / "
+                "_sendq)")
+        cfg = cfg.merged(elastic=True)
     if bool(cfg.get("elastic", False)):
         # --elastic (docs/PROTOCOL.md §9): shardctl + supervisor + the
         # scale mailbox, over a provisioned rank-space ceiling of
