@@ -128,6 +128,36 @@ READER_MB = float(os.environ.get("MPIT_BENCH_READER_MB", "0.25"))
 READER_ROUNDS = int(os.environ.get("MPIT_BENCH_READER_ROUNDS", "6"))
 READER_INTERVAL = float(os.environ.get("MPIT_BENCH_READER_INTERVAL_S", "1.0"))
 READER_BUDGET_MB = float(os.environ.get("MPIT_BENCH_READER_BUDGET_MB", "8"))
+# MPIT_BENCH_CELLS="1,2,3": the multi-cell serving-fabric sweep (ISSUE
+# 12, PROTOCOL.md §11).  Per cell count N, a TCP gang — 1 training
+# server + 1 writer + N replica cells + MPIT_BENCH_CELL_READERS
+# fabric-routed readers — runs paced whole-vector reads while the
+# writer commits a version per interval and samples its own GRAD
+# latency.  Every serving member (the cells; the server itself in the
+# N=0 direct-serving control that always runs first) models a fixed
+# per-member reply capacity of MPIT_BENCH_CELL_MBS (the BENCH_r11
+# member-throttle rationale: an unthrottled 1-core host measures
+# time-slicing, not fan-out), so aggregate read throughput scaling in
+# N is the capacity the fabric actually adds.  The sweep asserts reads
+# stay bitwise-correct and monotone; the kill leg
+# (MPIT_BENCH_CELL_KILL=1, default on, needs >= 2 cells) SIGKILLs one
+# cell mid-run and asserts every reader completes with zero
+# RetryExhausted and >= 1 failover.  Rows are serving-metric rows and
+# never join the codec=none baseline gate.
+CELLS_SWEEP = [int(x) for x in
+               os.environ.get("MPIT_BENCH_CELLS", "").split(",") if x]
+CELL_READERS = int(os.environ.get("MPIT_BENCH_CELL_READERS", "96"))
+CELL_MB = float(os.environ.get("MPIT_BENCH_CELL_MB", "0.25"))
+CELL_ROUNDS = int(os.environ.get("MPIT_BENCH_CELL_ROUNDS", "6"))
+CELL_INTERVAL = float(os.environ.get("MPIT_BENCH_CELL_INTERVAL_S", "0.15"))
+CELL_MBS = float(os.environ.get("MPIT_BENCH_CELL_MBS", "60"))
+CELL_MAX_LAG = int(os.environ.get("MPIT_BENCH_CELL_MAX_LAG", "8"))
+CELL_KILL = os.environ.get("MPIT_BENCH_CELL_KILL", "1") not in ("", "0")
+# Reader-host driver processes: one thread stepping ~100 ReaderClients
+# keeps up; past that the O(in-flight) poll scan becomes the measured
+# ceiling instead of the serving members (the PR 8 driver lesson) —
+# spread bigger populations over 2+ hosts.
+CELL_HOSTS = max(int(os.environ.get("MPIT_BENCH_CELL_HOSTS", "2")), 1)
 # MPIT_BENCH_ELASTIC=1: the shrink/grow sweep (ISSUE 9, PROTOCOL.md
 # §9) — three codec=none shm legs at 1 -> 2 -> 1 servers, capturing the
 # steady-state capacity the gang gains (and gives back) with each
@@ -976,6 +1006,383 @@ def _serve_child() -> None:
         json.dump(result, fh)
 
 
+def bench_cells(ncells: int, kill: bool = False) -> dict:
+    """One serving-fabric leg (MPIT_BENCH_CELLS): 1 training server + 1
+    writer + ``ncells`` replica cells + CELL_READERS fabric-routed
+    readers, every serving member throttled to CELL_MBS of modeled
+    reply capacity.  ``ncells=0`` is the direct-serving control (the
+    readers hit the training server, §8 style) — its GRAD p50 is the
+    no-fabric baseline the cells legs must stay flat against.  With
+    ``kill``, one cell is SIGKILLed mid-window and the leg additionally
+    asserts zero RetryExhausted and >= 1 reader failover."""
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from mpit_tpu.comm.tcp import allocate_local_addresses
+
+    size = int(CELL_MB * (1 << 20) / 4)
+    core = 2 + ncells  # server, writer, cells
+    nranks = core + CELL_READERS
+    cell_ranks = list(range(2, 2 + ncells))
+    # The listening children INHERIT the parent's bound sockets
+    # (pass_fds) instead of close-and-rebind: on loopback the kernel's
+    # ephemeral-port hand loves a just-freed port, so a sibling's
+    # outbound connect can squat a rebinding listener's port for the
+    # whole leg — the silent-child flake this layout removes.
+    addrs, socks = allocate_local_addresses(core)
+    addrs = addrs + ["127.0.0.1:0"] * CELL_READERS
+    _log(f"[cells] 1 server + 1 writer + {ncells} cells + {CELL_READERS} "
+         f"readers{' (kill leg)' if kill else ''}, vector "
+         f"{size * 4 / 2**20:.2f} MB, member capacity {CELL_MBS:.0f} MB/s, "
+         f"{CELL_ROUNDS} reads/reader at {CELL_INTERVAL:.2f}s pacing")
+    spec = {
+        "addrs": addrs, "ncells": ncells, "cell_ranks": cell_ranks,
+        "size": size, "rounds": CELL_ROUNDS, "interval": CELL_INTERVAL,
+        "member_mbs": CELL_MBS, "max_lag": CELL_MAX_LAG, "kill": kill,
+    }
+    tmpdir = tempfile.mkdtemp(prefix=f"ptest_cells_{os.getpid()}_")
+    batches = [list(range(core + i, nranks, CELL_HOSTS))
+               for i in range(CELL_HOSTS)]
+    jobs = ([("server", 0, None), ("writer", 1, None)]
+            + [("cell", c, None) for c in cell_ranks]
+            + [("readers", core + i, batch)
+               for i, batch in enumerate(batches) if batch])
+    procs, result_files, by_job = [], {}, {}
+    for role, label, batch in jobs:
+        result_path = os.path.join(tmpdir, f"{role}{label}.json")
+        result_files[(role, label)] = result_path
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PTEST_CELLS=json.dumps({**spec, "role": role, "rank": label,
+                                    "batch": batch or []}),
+            PTEST_RESULT=result_path,
+        )
+        pass_fds = ()
+        if role in ("server", "writer", "cell"):
+            fd = socks[label].fileno()
+            env["PTEST_LISTEN_FD"] = str(fd)
+            pass_fds = (fd,)
+        log_path = result_path.replace(".json", ".log")
+        with open(log_path, "w") as fh:
+            p = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--cells-child"],
+                env=env, stdout=fh, stderr=subprocess.STDOUT, text=True,
+                pass_fds=pass_fds,
+            )
+        procs.append(p)
+        by_job[(role, label)] = p
+    for s in socks:
+        s.close()  # the children own their inherited copies now
+    victim = cell_ranks[0] if (kill and ncells >= 2) else None
+    # The kill anchors to the READ WINDOW, not the spawn: the reader
+    # host drops a .started marker once every reader finished its
+    # warmup read, and the victim dies 40% into the paced window — a
+    # kill during gang formation would tear reader *construction*
+    # dials, which is a different (uninteresting) failure.
+    started_markers = [path + ".started"
+                       for (role, _l), path in result_files.items()
+                       if role == "readers"]
+    kill_at: "float | None" = None
+    deadline = time.monotonic() + float(
+        os.environ.get("MPIT_BENCH_GANG_TIMEOUT", "900"))
+    killed = False
+    try:
+        while any(p.poll() is None for p in procs):
+            if victim is not None and not killed and kill_at is None \
+                    and all(os.path.exists(m) for m in started_markers):
+                kill_at = time.monotonic() + (CELL_ROUNDS
+                                              * CELL_INTERVAL) * 0.4
+            if victim is not None and not killed and kill_at is not None \
+                    and time.monotonic() >= kill_at:
+                by_job[("cell", victim)].send_signal(_signal.SIGKILL)
+                killed = True
+                _log(f"[cells] SIGKILLed cell {victim} mid-window")
+            bad = next(
+                (i for i, p in enumerate(procs)
+                 if p.poll() not in (None, 0)
+                 and not (killed and p is by_job[("cell", victim)])),
+                None)
+            if bad is not None or time.monotonic() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for path in result_files.values():
+                    logp = path.replace(".json", ".log")
+                    if os.path.exists(logp):
+                        with open(logp) as fh:
+                            sys.stderr.write(fh.read())
+                raise RuntimeError(
+                    f"cells gang job {jobs[bad][:2]} failed (logs: {tmpdir})"
+                    if bad is not None else
+                    f"cells gang timed out (logs: {tmpdir})")
+            time.sleep(0.1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    host_recs = [json.load(open(path))
+                 for (role, _l), path in result_files.items()
+                 if role == "readers"]
+    reader_rec = {
+        "samples": [s for r in host_recs for s in r["samples"]],
+        "reads": sum(r["reads"] for r in host_recs),
+        "failovers": sum(r["failovers"] for r in host_recs),
+        "busy_honored": sum(r["busy_honored"] for r in host_recs),
+        "max_lag_seen": max(r["max_lag_seen"] for r in host_recs),
+        "errors": [e for r in host_recs for e in r["errors"]],
+        "t0": min(r["t0"] for r in host_recs),
+        "t1": max(r["t1"] for r in host_recs),
+    }
+    writer_rec = json.load(open(result_files[("writer", 1)]))
+    cells_rec = []
+    for c in cell_ranks:
+        if c == victim:
+            continue  # SIGKILLed: no result file, by design
+        cells_rec.append(json.load(open(result_files[("cell", c)])))
+    samples = np.asarray(reader_rec["samples"])
+    dt = reader_rec["t1"] - reader_rec["t0"]
+    reads = reader_rec["reads"]
+    mbs = reads * size * 4 / dt / 2**20
+    p50 = float(np.percentile(samples, 50)) * 1e3
+    p99 = float(np.percentile(samples, 99)) * 1e3
+    if kill:
+        if reader_rec["failovers"] < 1:
+            raise RuntimeError(
+                "kill leg: no reader ever failed over — the victim "
+                "served nobody?")
+        if reader_rec["errors"]:
+            raise RuntimeError(
+                f"kill leg drew RetryExhausted: {reader_rec['errors']}")
+    import shutil
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    _log(f"[cells] n={ncells}{'+kill' if kill else ''}: {mbs:.1f} MB/s "
+         f"aggregate reads (p50 {p50:.1f} ms), GRAD p50 "
+         f"{writer_rec['grad_p50_ms']:.1f} ms, failovers="
+         f"{reader_rec['failovers']}, max observed lag "
+         f"{reader_rec['max_lag_seen']}")
+    return {
+        "metric": "ps_cells_serving",
+        "unit": "MB/s",
+        "value": round(mbs, 1),
+        "cells": ncells,
+        "kill": bool(kill),
+        "readers": CELL_READERS,
+        "reads": reads,
+        "read_p50_ms": round(p50, 2),
+        "read_p99_ms": round(p99, 2),
+        "grad_p50_ms": round(writer_rec["grad_p50_ms"], 2),
+        "grad_p99_ms": round(writer_rec["grad_p99_ms"], 2),
+        "member_mbs": CELL_MBS,
+        "vector_mb": round(size * 4 / 2**20, 3),
+        "interval_s": CELL_INTERVAL,
+        "failovers": reader_rec["failovers"],
+        "busy_honored": reader_rec["busy_honored"],
+        "max_lag_seen": reader_rec["max_lag_seen"],
+        "max_lag_bound": CELL_MAX_LAG,
+        "diffs_installed": sum(c["diffs_installed"] for c in cells_rec),
+        "resyncs": sum(c["resyncs"] for c in cells_rec),
+    }
+
+
+def _cells_child() -> None:
+    """One process of the serving-fabric gang (--cells-child): the
+    training server (diff producer; direct reader serving in the N=0
+    control), the writer (samples its own GRAD latency — the flatness
+    claim), one replica cell, or the reader host driving the
+    fabric-routed reader population."""
+    import numpy as np
+
+    from mpit_tpu.comm.tcp import TcpTransport
+    from mpit_tpu.ft import FTConfig, RetryExhausted
+    from mpit_tpu.ps import ParamClient, ParamServer, ReaderClient, ServeConfig
+
+    spec = json.loads(os.environ["PTEST_CELLS"])
+    addrs = spec["addrs"]
+    nranks = len(addrs)
+    cell_ranks = spec["cell_ranks"]
+    ncells = spec["ncells"]
+    core = 2 + ncells
+    readers = list(range(core, nranks))
+    size = spec["size"]
+    rounds, interval = spec["rounds"], spec["interval"]
+    member_mbs = spec["member_mbs"]
+    role = spec["role"]
+    listener = None
+    if "PTEST_LISTEN_FD" in os.environ:
+        import socket as _socket
+
+        listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM,
+                                  fileno=int(os.environ["PTEST_LISTEN_FD"]))
+
+    def throttle(member) -> None:
+        """Model a fixed per-member reply capacity: every granted read
+        spends frame_bytes/member_mbs of the member's (single-threaded)
+        time, exactly the BENCH_r11 throttle shape."""
+        inner = member._snapshot_wire
+        cost = size * 4 / (member_mbs * (1 << 20))
+
+        def wrapped(codec):
+            time.sleep(cost)
+            return inner(codec)
+
+        member._snapshot_wire = wrapped
+
+    ft = FTConfig(op_deadline_s=60.0)
+    if role == "server":
+        transport = TcpTransport(0, nranks, addrs, listener=listener,
+                                 reconnect=120.0, dial_peers=[],
+                                 connect_timeout=120.0)
+        server = ParamServer(
+            0, [1], transport, rule="add",
+            reader_ranks=(readers if ncells == 0 else None),
+            cell_ranks=(cell_ranks or None),
+            serve=ServeConfig(budget_bytes=1 << 30),
+            ft=FTConfig(lease_ttl_s=5.0))
+        if ncells == 0:
+            throttle(server)  # the control serves reads itself
+        server.start()
+        result = {
+            "role": "server",
+            "snap_version": server._snap_version,
+            "params_served": server.params_served,
+            "grads_applied": server.grads_applied,
+            "diffs_sent": int(server._m_diff_full.value)
+            + int(server._m_diff_delta.value),
+        }
+        transport.close()
+    elif role == "writer":
+        transport = TcpTransport(1, nranks, addrs, listener=listener,
+                                 reconnect=120.0, dial_peers=[0],
+                                 connect_timeout=120.0)
+        client = ParamClient(1, [0], transport, seed_servers=True, ft=ft)
+        param = np.arange(size, dtype=np.float32)
+        grad = np.full(size, 1e-6, np.float32)
+        client.start(param, grad)
+        lat = []
+        # One committed version per pacing interval across the whole
+        # read window (+2 slack), each grad individually timed: this
+        # distribution's p50 is the "training stays flat" claim.
+        for _ in range(rounds + 2):
+            t0 = time.monotonic()
+            client.async_send_grad()
+            client.wait()
+            lat.append(time.monotonic() - t0)
+            time.sleep(interval)
+        client.stop()
+        result = {
+            "role": "writer", "grads": rounds + 2,
+            "grad_p50_ms": float(np.percentile(lat, 50)) * 1e3,
+            "grad_p99_ms": float(np.percentile(lat, 99)) * 1e3,
+        }
+        transport.close()
+    elif role == "cell":
+        from mpit_tpu.cells.cell import ServingCell
+
+        rank = spec["rank"]
+        transport = TcpTransport(rank, nranks, addrs, listener=listener,
+                                 reconnect=120.0, dial_peers=[0],
+                                 connect_timeout=120.0)
+        cell = ServingCell(
+            rank, 0, transport, readers, size=size,
+            max_lag=spec["max_lag"],
+            serve=ServeConfig(budget_bytes=1 << 30),
+            ft=FTConfig(heartbeat_s=0.2, op_deadline_s=60.0))
+        throttle(cell)
+        cell.start()
+        result = {
+            "role": "cell",
+            "version": cell.version,
+            "params_served": cell.params_served,
+            "diffs_installed": cell.diffs_installed,
+            "resyncs": cell.resyncs,
+            "lag_sheds": cell.lag_sheds,
+        }
+        transport.close()
+    else:  # reader host: the paced fabric-routed population
+        batch = spec["batch"]
+        serving = cell_ranks if ncells else [0]
+        transports, clients = {}, {}
+        reader_ft = FTConfig(op_deadline_s=(2.0 if spec["kill"] else 60.0),
+                             max_retries=8)
+        for r in batch:
+            transports[r] = TcpTransport(r, nranks, addrs, reconnect=120.0,
+                                         dial_peers=serving, listen=False,
+                                         connect_timeout=120.0)
+            clients[r] = ReaderClient(
+                r, [0], transports[r], ft=reader_ft,
+                cells=({0: cell_ranks} if ncells else None))
+            clients[r].start(np.zeros(size, np.float32))
+        for r in batch:  # warmup (first-touch, codec caches)
+            clients[r].read_params()
+        # The paced window starts now — the kill leg's parent waits
+        # for this marker before arming the SIGKILL.
+        open(os.environ["PTEST_RESULT"] + ".started", "w").close()
+        t_start = time.time()
+        base = time.monotonic()
+        state = {r: {"next": base + (i / max(len(batch), 1)) * interval,
+                     "t0": None, "reads": 0}
+                 for i, r in enumerate(batch)}
+        samples, errors = [], []
+        max_lag_seen = 0
+        import heapq
+
+        inflight: set = set()
+        due = [(state[r]["next"], r) for r in batch]
+        heapq.heapify(due)
+        pending = len(batch)
+        while pending or inflight:
+            now = time.monotonic()
+            while due and due[0][0] <= now:
+                _t, r = heapq.heappop(due)
+                clients[r].async_read_params()
+                state[r]["t0"] = time.monotonic()
+                inflight.add(r)
+            for r in list(inflight):
+                try:
+                    busy = clients[r].poll()
+                except RetryExhausted as exc:
+                    errors.append(f"reader {r}: {exc!r}")
+                    inflight.discard(r)
+                    pending -= 1
+                    continue
+                if not busy:
+                    st = state[r]
+                    samples.append(time.monotonic() - st["t0"])
+                    st["reads"] += 1
+                    max_lag_seen = max(max_lag_seen,
+                                       clients[r].lags.get(0, 0))
+                    st["next"] = st["t0"] + interval
+                    st["t0"] = None
+                    inflight.discard(r)
+                    if st["reads"] >= rounds:
+                        pending -= 1
+                    else:
+                        heapq.heappush(due, (st["next"], r))
+            time.sleep(0.0002 if inflight else 0.001)
+        t_end = time.time()
+        for r in batch:
+            assert clients[r].monotone, f"reader {r} saw a version go back"
+            clients[r].stop()
+            transports[r].close()
+        result = {
+            "role": "readers", "samples": samples,
+            "reads": sum(st["reads"] for st in state.values()),
+            "busy_honored": sum(c.busy_honored for c in clients.values()),
+            "failovers": sum(c.failovers for c in clients.values()),
+            "max_lag_seen": max_lag_seen,
+            "errors": errors,
+            "t0": t_start, "t1": t_end,
+        }
+        if errors and not spec["kill"]:
+            raise SystemExit(f"readers drew RetryExhausted: {errors}")
+    with open(os.environ["PTEST_RESULT"], "w") as fh:
+        json.dump(result, fh)
+
+
 def _shm_run_threads(size: int, heartbeat: bool = False) -> float:
     """One timed gang: T rounds of {pull, push, wait} per client, all
     ranks as threads of this process (debug mode — see module docstring
@@ -1081,6 +1488,17 @@ def main():
         # per reader count; rows are latency-metric, not bandwidth, and
         # never join the codec=none baseline gate.
         results.extend(bench_readers(n) for n in READERS_SWEEP)
+    if CELLS_SWEEP and MODE in ("shm", "both"):
+        # Multi-cell serving fabric (TCP gangs, per-member capacity
+        # model): the N=0 direct-serving control first, then one leg
+        # per cell count, then the kill-a-cell leg at the largest
+        # count >= 2.  Serving-metric rows: never join the codec=none
+        # baseline gate.
+        results.append(bench_cells(0))
+        results.extend(bench_cells(n) for n in CELLS_SWEEP if n > 0)
+        killable = [n for n in CELLS_SWEEP if n >= 2]
+        if CELL_KILL and killable:
+            results.append(bench_cells(max(killable), kill=True))
     if SKEW_SWEEP and MODE in ("shm", "both"):
         # The straggler A/B runs at codec=none (the skew is in the
         # *reply latency*, not the byte volume): rebalance off, then on.
@@ -1118,5 +1536,7 @@ if __name__ == "__main__":
         _gang_child()
     elif "--serve-child" in sys.argv:
         _serve_child()
+    elif "--cells-child" in sys.argv:
+        _cells_child()
     else:
         main()

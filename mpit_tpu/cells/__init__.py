@@ -1,0 +1,46 @@
+"""mpit_tpu.cells — the multi-cell serving fabric (docs/PROTOCOL.md §11).
+
+Follower *serving cells* subscribe to a training server's committed
+version stream (snapshot diffs on the DIFF channel), install the frames
+into their own version-counted serving cache, and answer READ-ONLY
+reader traffic under an enforced staleness bound — N cells x M readers
+cost the training gang one diff stream per cell, not M reads.
+
+- :mod:`mpit_tpu.cells.wire` — DIFF frame layout + the encoded frame
+  history the diff producer draws deltas from.
+- :mod:`mpit_tpu.cells.cell` — :class:`ServingCell`, the follower rank.
+- :mod:`mpit_tpu.cells.ring` — consistent-hash reader routing.
+- :mod:`mpit_tpu.cells.autoscale` — per-cell SLO autoscaling verbs.
+
+Heavy members load lazily: :mod:`mpit_tpu.ps.server` imports the wire
+module from here, and :class:`ServingCell` imports the server back — a
+module-level import cycle this ``__getattr__`` indirection breaks.
+"""
+
+from mpit_tpu.cells.wire import (  # noqa: F401
+    DIFF_DELTA,
+    DIFF_FULL,
+    FrameHistory,
+)
+
+_LAZY = {
+    "ServingCell": ("mpit_tpu.cells.cell", "ServingCell"),
+    "CellRing": ("mpit_tpu.cells.ring", "CellRing"),
+    "CellAutoscaler": ("mpit_tpu.cells.autoscale", "CellAutoscaler"),
+    "CellSLO": ("mpit_tpu.cells.autoscale", "CellSLO"),
+}
+
+__all__ = ["DIFF_DELTA", "DIFF_FULL", "FrameHistory",
+           "ServingCell", "CellRing", "CellAutoscaler", "CellSLO"]
+
+
+def __getattr__(name: str):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    module = importlib.import_module(entry[0])
+    value = getattr(module, entry[1])
+    globals()[name] = value
+    return value

@@ -112,7 +112,7 @@ def allocate_local_addresses(nranks: int) -> Tuple[List[str], List[socket.socket
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("127.0.0.1", 0))
-        s.listen(nranks)
+        s.listen(max(nranks, 64))  # serving-tier gangs burst-dial
         addrs.append(f"127.0.0.1:{s.getsockname()[1]}")
         socks.append(s)
     return addrs, socks
@@ -426,6 +426,17 @@ class TcpTransport(Transport):
         while time.monotonic() < deadline and not self._closed:
             try:
                 conn = socket.create_connection((host, int(port)), timeout=5.0)
+                if conn.getsockname() == conn.getpeername():
+                    # TCP simultaneous-connect to our own ephemeral
+                    # port: the peer's listener is not up yet and the
+                    # kernel handed us a loopback self-connection —
+                    # worse than useless, it also squats the very port
+                    # the peer is trying to bind.  Close (freeing the
+                    # port) and retry like any not-up-yet peer.
+                    conn.close()
+                    raise ConnectionRefusedError(
+                        errno.ECONNREFUSED,
+                        "self-connect: peer listener not up yet")
                 conn.settimeout(None)
                 with self._lock:
                     my_last = self._last_seq[peer_rank]
@@ -906,6 +917,15 @@ class TcpTransport(Transport):
         if d.state == "connecting":
             err = d.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
             if err:
+                self._dial_retry(d, now)
+                return
+            try:
+                if d.sock.getsockname() == d.sock.getpeername():
+                    # Loopback self-connect (see _dial): drop it so the
+                    # peer can bind its listener, then redial.
+                    self._dial_retry(d, now)
+                    return
+            except OSError:
                 self._dial_retry(d, now)
                 return
             with self._lock:

@@ -41,6 +41,7 @@ from mpit_tpu.ft.wire import (
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
     FLAG_READONLY,
+    FLAG_SUBSCRIBE,
     FLAG_STALENESS,
     FLAG_TIMING,
     HDR_BYTES,
@@ -71,7 +72,7 @@ __all__ = [
     "Scenario", "TrafficPhase", "TrafficEvent",
     "HDR_BYTES", "HDR_STALE_BYTES",
     "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_READONLY", "FLAG_STALENESS",
-    "FLAG_TIMING",
+    "FLAG_SUBSCRIBE", "FLAG_TIMING",
     "ACK_TIMING_WORDS", "TIMING_TAIL_BYTES",
     "hdr_bytes", "reply_hdr_bytes",
     "pack_header", "unpack_header", "header_frame", "timed_frame",

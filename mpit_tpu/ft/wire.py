@@ -86,6 +86,20 @@ FLAG_TIMING = 8
 #: a rejoining incarnation.
 FLAG_READONLY = 16
 
+#: INIT v3 flags bit5: SUBSCRIBE attach (the multi-cell serving fabric,
+#: docs/PROTOCOL.md §11).  The announcing peer is a *replica cell*: a
+#: follower serving rank that will never send GRAD/PARAM_PUSH and never
+#: request PARAM reads — instead the server streams it the committed
+#: version sequence on the DIFF channel (full encoded snapshot on
+#: attach, then per-version deltas out of the snapshot cache), and the
+#: cell serves READ-ONLY reader traffic from its own installed copy
+#: under a declared staleness bound.  Extends the §8 READ-ONLY
+#: handshake: FLAG_SUBSCRIBE requires FLAG_READONLY | FLAG_FRAMED, and
+#: the subscriber's HEARTBEAT beacons are answered with a 3-word
+#: [epoch, seq, head_version] echo so its view of the head version
+#: never depends on the (possibly delayed) diff stream itself.
+FLAG_SUBSCRIBE = 32
+
 #: the timing tail: int64 [t_tx_echo_us, t_recv_us, t_ack_us]
 TIMING_TAIL_WORDS = 3
 TIMING_TAIL_BYTES = 8 * TIMING_TAIL_WORDS

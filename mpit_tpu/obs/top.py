@@ -209,6 +209,14 @@ def _rank_row(rank: int, sample: Optional[dict],
         # Elastic membership (PROTOCOL.md §9): the controller rank
         # publishes the live server count; everyone else reads 0.
         "gang_size": int(metric_sum(m, "mpit_gang_size", role="server")),
+        # Multi-cell fabric (PROTOCOL.md §11): a cell rank publishes
+        # its serving version and lag vs the upstream head; readers
+        # attached ride the shared mpit_ps_readers gauge, and reader
+        # ranks publish their fail-over/GOODBYE reroutes.
+        "cell_version": int(metric_sum(m, "mpit_cell_version")),
+        "cell_lag": int(metric_sum(m, "mpit_cell_lag")),
+        "readers": int(metric_sum(m, "mpit_ps_readers")),
+        "reroutes": int(metric_sum(m, "mpit_ps_reader_reroutes_total")),
         "inflight": len(status.get("inflight_ops") or []),
     }
     # SLO columns (ISSUE 11): BUSY-reply ratio (admission rejections
@@ -280,7 +288,7 @@ def render_autoscale_line(section: Optional[dict]) -> str:
 _COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "slo", "busy%",
             "sendq", "conns",
             "busy", "stale", "retry", "evict", "shards", "busy_s", "mapv",
-            "gang", "infl")
+            "gang", "cellv", "lag", "rdrs", "rrt", "infl")
 
 
 def render_table(rows: List[Dict[str, object]]) -> str:
@@ -310,6 +318,13 @@ def render_table(rows: List[Dict[str, object]]) -> str:
             f"{row['shard_busy_s']:.2f}" if row["shard_busy_s"] else "-",
             str(row["map_version"]) if row["map_version"] else "-",
             str(row["gang_size"]) if row.get("gang_size") else "-",
+            # Cell-fabric columns (§11): only meaningful on cell /
+            # reader rows — everyone else shows '-'.
+            (str(row["cell_version"]) if row.get("role") == "cell"
+             else "-"),
+            (str(row["cell_lag"]) if row.get("role") == "cell" else "-"),
+            str(row["readers"]) if row.get("readers") else "-",
+            str(row["reroutes"]) if row.get("reroutes") else "-",
             str(row["inflight"]),
         ]
 

@@ -113,6 +113,19 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     serve_interval_s=0.05,
     serve_budget_mb=64.0,
     serve_budget_reads=0,
+    # Multi-cell serving fabric (mpit_tpu.cells; docs/PROTOCOL.md §11):
+    # --cells N inserts N replica serving cells between the training
+    # roles and the readers.  Cells SUBSCRIBE to their upstream server's
+    # committed version stream (one diff stream each), serve the reader
+    # traffic under the cell_max_lag staleness bound, and readers route
+    # across the cells of each shard by consistent hashing, failing
+    # over to ring siblings on cell death (zero RetryExhausted while a
+    # sibling lives).  Requires serve_readers > 0 (someone to serve),
+    # ft_op_deadline_s > 0 and ft_heartbeat_s > 0 (cell leases + head
+    # echoes ride the beat channel), and N >= the server count (every
+    # shard needs a replica).
+    cells=0,
+    cell_max_lag=4,
     # Elastic gangs (mpit_tpu.ft.elastic; docs/PROTOCOL.md §9): --elastic
     # composes shardctl + the supervisor into dynamic membership.
     # elastic_spares reserves that many joiner-server rank slots beyond
@@ -261,11 +274,63 @@ def _serve_vec_len(cfg: Config, rank: int) -> int:
     return int(flatten_module(module, rng, sample).w0.size)
 
 
+def cell_map_for(sranks: List[int], cell_ranks: List[int]) -> Dict[int, List[int]]:
+    """Round-robin assignment of replica cells to server slots: cell i
+    mirrors sranks[i % S], so every shard gets ceil(N/S) replicas and
+    siblings exist whenever N >= 2S (§11.5)."""
+    out: Dict[int, List[int]] = {s: [] for s in sranks}
+    for i, c in enumerate(cell_ranks):
+        out[sranks[i % len(sranks)]].append(c)
+    return out
+
+
+def run_cell(rank: int, sranks: List[int], cell_ranks: List[int],
+             reader_ranks: List[int], cfg: Config,
+             transport: Any) -> Dict[str, Any]:
+    """One replica serving cell (§11): subscribe to the assigned
+    upstream server's version stream, serve the fabric's readers under
+    the staleness bound, stop when every reader is terminal."""
+    from mpit_tpu.cells.cell import ServingCell
+    from mpit_tpu.shardctl import shardmap as _shardmap
+
+    log = get_logger("cell", rank)
+    cmap = cell_map_for(sranks, cell_ranks)
+    upstream = next(s for s, cs in cmap.items() if rank in cs)
+    vec_len = _serve_vec_len(cfg, rank)
+    smap = _shardmap.ShardMap.initial(vec_len, sranks)
+    shard = dict(zip(sranks, (e.shard for e in smap.entries)))[upstream]
+    cell = ServingCell(
+        rank, upstream, transport, reader_ranks,
+        offset=shard.offset, size=shard.size,
+        dtype=cfg.get("dtype", "float32"),
+        codec=str(cfg.get("codec", "") or "") or None,
+        max_lag=int(cfg.get("cell_max_lag", 4)),
+        ft=ft_from_cfg(cfg),
+        serve=serve_cfg_for(cfg),
+    )
+    log.info("cell for upstream %d, shard (%d,%d), readers %s",
+             upstream, shard.offset, shard.size, reader_ranks)
+    cell.start()
+    return {
+        "role": "cell",
+        "upstream": upstream,
+        "version": cell.version,
+        "head": cell.head,
+        "params_served": cell.params_served,
+        "busy_replies": cell.busy_replies,
+        "diffs_installed": cell.diffs_installed,
+        "resyncs": cell.resyncs,
+        "lag_sheds": cell.lag_sheds,
+    }
+
+
 def run_reader(rank: int, sranks: List[int], cfg: Config,
-               transport: Any) -> Dict[str, Any]:
+               transport: Any,
+               cell_ranks: Optional[List[int]] = None) -> Dict[str, Any]:
     """One READ-ONLY reader rank (serve mode): attach, pull the current
     params ``serve_rounds`` times at ``serve_interval_s`` pacing, check
-    version monotonicity, stop."""
+    version monotonicity, stop.  With a cell fabric the reads route
+    across the replica cells instead of the training servers (§11.5)."""
     import numpy as np
 
     from mpit_tpu.ps import ReaderClient
@@ -275,6 +340,7 @@ def run_reader(rank: int, sranks: List[int], cfg: Config,
         rank, sranks, transport,
         codec=str(cfg.get("codec", "") or "") or None,
         ft=ft_from_cfg(cfg),
+        cells=(cell_map_for(sranks, cell_ranks) if cell_ranks else None),
     )
     mirror = np.zeros(_serve_vec_len(cfg, rank),
                       np.dtype(str(cfg.get("dtype", "float32"))))
@@ -295,6 +361,9 @@ def run_reader(rank: int, sranks: List[int], cfg: Config,
         "busy_honored": rc.busy_honored,
         "retries": rc.retries,
         "versions": {str(k): v for k, v in rc.versions.items()},
+        "read_versions": {str(k): v for k, v in rc.read_versions.items()},
+        "lags": {str(k): v for k, v in rc.lags.items()},
+        "failovers": rc.failovers,
     }
 
 
@@ -404,7 +473,12 @@ def run_rank(
     ctl_rank: Optional[int] = None
     role_size = size
     n_readers = int(cfg.get("serve_readers", 0) or 0)
+    n_cells = int(cfg.get("cells", 0) or 0)
     reader_ranks: List[int] = []
+    cell_ranks: List[int] = []
+    if n_cells and not n_readers:
+        raise ValueError("--cells without --serve_readers: a cell fabric "
+                         "exists to serve readers")
     if n_readers:
         if sc_on:
             raise ValueError("serve_readers and shardctl are mutually "
@@ -415,12 +489,18 @@ def run_rank(
         if float(cfg.get("ft_op_deadline_s", 0) or 0) <= 0:
             raise ValueError("serve_readers needs --ft_op_deadline_s > 0: "
                              "BUSY recovery rides the FT retry machinery")
-        if size - n_readers < 2:
+        if n_cells and float(cfg.get("ft_heartbeat_s", 0) or 0) <= 0:
+            raise ValueError("--cells needs --ft_heartbeat_s > 0: cell "
+                             "leases and the head echoes ride the beat "
+                             "channel (§11.3)")
+        if size - n_readers - n_cells < 2:
             raise ValueError(
-                f"serve_readers={n_readers} leaves {size - n_readers} "
-                "role ranks; need >= 1 server + >= 1 worker")
-        role_size = size - n_readers
-        reader_ranks = list(range(role_size, size))
+                f"serve_readers={n_readers} + cells={n_cells} leave "
+                f"{size - n_readers - n_cells} role ranks; need >= 1 "
+                "server + >= 1 worker")
+        role_size = size - n_readers - n_cells
+        cell_ranks = list(range(role_size, role_size + n_cells))
+        reader_ranks = list(range(role_size + n_cells, size))
     if sc_on:
         if str(cfg.get("tester", "none")) != "none":
             raise ValueError("shardctl and a tester rank are mutually "
@@ -437,8 +517,16 @@ def run_rank(
         role_size, cfg.get("master_freq", 2), cfg.get("tester", "none")
     )
     single_mode = str(cfg.opt).endswith("-single")
+    if cell_ranks and len(cell_ranks) < len(sranks):
+        raise ValueError(
+            f"cells={n_cells} < {len(sranks)} servers: every shard "
+            "needs at least one replica cell")
     if rank in reader_ranks:
-        return run_reader(rank, sranks, cfg, transport)
+        return run_reader(rank, sranks, cfg, transport,
+                          cell_ranks=cell_ranks or None)
+    if rank in cell_ranks:
+        return run_cell(rank, sranks, cell_ranks, reader_ranks, cfg,
+                        transport)
     if elastic_on and rank >= np0:
         # A spare slot the controller asked the supervisor to spawn:
         # a joiner server — no INIT rendezvous, shards arrive by
@@ -545,8 +633,14 @@ def run_rank(
             codec=str(cfg.get("codec", "") or "") or None,
             ft=ft,
             controller_rank=ctl_rank,
-            reader_ranks=reader_ranks or None,
-            serve=serve_cfg_for(cfg) if reader_ranks else None,
+            # With a cell fabric the readers attach to the CELLS, not
+            # here — the server's serving surface is one diff stream
+            # per assigned cell (§11.2).
+            reader_ranks=(None if cell_ranks else (reader_ranks or None)),
+            cell_ranks=(cell_map_for(sranks, cell_ranks)[rank]
+                        if cell_ranks else None),
+            serve=serve_cfg_for(cfg) if (reader_ranks and not cell_ranks)
+            else None,
             preempt=_maybe_preemption(cfg),
             dplane=(_dplane_cfg(cfg) if int(cfg.get("dplane", 0)) else None),
         )
@@ -613,11 +707,14 @@ def expected_role(rank: int, size: int, cfg: Config) -> str:
     if sc_on and rank == np0 - 1:
         return "controller"
     n_readers = int(cfg.get("serve_readers", 0) or 0)
+    n_cells = int(cfg.get("cells", 0) or 0)
     if n_readers and rank >= size - n_readers:
         return "reader"
+    if n_cells and rank >= size - n_readers - n_cells:
+        return "cell"
     try:
         sranks, _cranks, tester_rank = assign_roles(
-            np0 - 1 if sc_on else size - n_readers,
+            np0 - 1 if sc_on else size - n_readers - n_cells,
             int(cfg.get("master_freq", 2)),
             str(cfg.get("tester", "none")))
     except ValueError:
@@ -673,7 +770,9 @@ def device_env_overrides(cfg: Config, size: int) -> Dict[int, Dict[str, str]]:
         role_size = role_size - 1 if (bool(cfg.get("shardctl", False))
                                       or bool(cfg.get("elastic", False))) \
             else role_size
-        role_size -= int(cfg.get("serve_readers", 0) or 0)  # readers: host roles
+        # readers and replica cells are host roles
+        role_size -= int(cfg.get("serve_readers", 0) or 0)
+        role_size -= int(cfg.get("cells", 0) or 0)
         sranks, cranks, tester = assign_roles(
             role_size, int(cfg.get("master_freq", 2)),
             str(cfg.get("tester", "none"))

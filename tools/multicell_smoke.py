@@ -1,0 +1,196 @@
+"""CI multicell-smoke (docs/PROTOCOL.md §11): a training gang + 2
+replica serving cells (real child processes) + fabric-routed readers,
+with one cell SIGKILLed mid-run.
+
+Asserts, loudly:
+- zero RetryExhausted across every reader, before and after the kill —
+  readers routed to the dead cell fail over to the live sibling inside
+  their retry loop (consistent-hash ring, §11.5);
+- every completed read is bitwise-equal to the upstream snapshot at its
+  stamped version, versions are monotone per serving rank, and the
+  observed lag never exceeds the declared max_lag;
+- at least one reader actually crossed the failover path, and left a
+  validated ``cell_failover`` flight dump behind;
+- the training gang shuts down cleanly: the killed cell is EVICTED by
+  its upstream lease (detected, not discovered), the survivor retires
+  with a STOP;
+- the obs trace of the driving process validates.
+
+Usage: python tools/multicell_smoke.py <trace_out.json> [flight_dir]
+"""
+
+import multiprocessing
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from mpit_tpu import obs  # noqa: E402
+from mpit_tpu.cells.cell import ServingCell  # noqa: E402
+from mpit_tpu.comm.tcp import TcpTransport, allocate_local_addresses  # noqa: E402
+from mpit_tpu.ft import FTConfig  # noqa: E402
+from mpit_tpu.obs import flight as obs_flight  # noqa: E402
+from mpit_tpu.obs import trace as obs_trace  # noqa: E402
+from mpit_tpu.ps import ParamClient, ParamServer, ReaderClient  # noqa: E402
+
+NCELLS, NREADERS, ROUNDS, SIZE, MAX_LAG = 2, 8, 10, 16384, 4
+
+
+def _cell_child(rank: int, addrs, sock, reader_ranks, nranks):
+    """One replica cell in its own process (so a SIGKILL is a real
+    SIGKILL: no STOP, no GOODBYE, every link torn at once)."""
+    tr = TcpTransport(rank, nranks, addrs, listener=sock,
+                      reconnect=60.0, dial_peers=list(range(rank)))
+    cell = ServingCell(
+        rank, 0, tr, reader_ranks, size=SIZE, max_lag=MAX_LAG,
+        ft=FTConfig(heartbeat_s=0.1, op_deadline_s=30.0))
+    cell.start()
+    tr.close()
+    os._exit(0)
+
+
+def main(trace_path: str, flight_dir: str) -> int:
+    os.environ["MPIT_OBS_FLIGHT"] = flight_dir
+    os.makedirs(flight_dir, exist_ok=True)
+    obs.configure(enabled=True, reset=True)
+    core = 2 + NCELLS  # server, writer, cells
+    nranks = core + NREADERS
+    addrs, socks = allocate_local_addresses(core)
+    addrs += ["127.0.0.1:0"] * NREADERS
+    cell_ranks = [2, 3]
+    reader_ranks = list(range(core, nranks))
+
+    # Cells fork FIRST (they inherit only their own listener).
+    ctx = multiprocessing.get_context("fork")
+    procs = {}
+    for c in cell_ranks:
+        procs[c] = ctx.Process(target=_cell_child,
+                               args=(c, addrs, socks[c], reader_ranks,
+                                     nranks))
+        procs[c].start()
+
+    tr = {}
+
+    def build(r):
+        tr[r] = TcpTransport(r, nranks, addrs, listener=socks[r],
+                             reconnect=60.0, dial_peers=list(range(r)))
+
+    ths = [threading.Thread(target=build, args=(r,)) for r in (0, 1)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(60)
+    assert len(tr) == 2, "core mesh construction hung"
+
+    server = ParamServer(0, [1], tr[0], rule="add", cell_ranks=cell_ranks,
+                         ft=FTConfig(lease_ttl_s=3.0))
+    sth = threading.Thread(target=server.start, daemon=True)
+    sth.start()
+
+    client = ParamClient(1, [0], tr[1], seed_servers=True,
+                         ft=FTConfig(op_deadline_s=60.0))
+    param = np.arange(SIZE, dtype=np.float32)
+    grad = np.ones(SIZE, np.float32)
+    client.start(param.copy(), grad)
+
+    failures = []
+    stats = {}
+
+    def run_reader(rank):
+        t = TcpTransport(rank, nranks, addrs, reconnect=60.0,
+                         dial_peers=cell_ranks, listen=False,
+                         connect_timeout=120.0)
+        try:
+            rc = ReaderClient(rank, [0], t, cells={0: cell_ranks},
+                              failover_after=2,
+                              ft=FTConfig(op_deadline_s=1.0,
+                                          max_retries=8))
+            mirror = np.zeros(SIZE, np.float32)
+            rc.start(mirror)
+            reads = []
+            for _ in range(ROUNDS):
+                rc.read_params()
+                reads.append((rc.read_versions[0], rc.lags[0],
+                              mirror.copy()))
+                time.sleep(0.15)
+            rc.stop()
+            stats[rank] = {"reads": reads, "monotone": rc.monotone,
+                           "failovers": rc.failovers}
+        except Exception as exc:  # noqa: BLE001 — smoke reports, never hangs
+            failures.append(f"reader {rank}: {exc!r}")
+        finally:
+            t.close()
+
+    rth = [threading.Thread(target=run_reader, args=(r,))
+           for r in reader_ranks]
+    for t in rth:
+        t.start()
+
+    # Commit a few versions, then SIGKILL one cell mid-run.
+    for _ in range(3):
+        client.async_send_grad()
+        client.wait()
+        time.sleep(0.1)
+    victim = cell_ranks[0]
+    os.kill(procs[victim].pid, signal.SIGKILL)
+    procs[victim].join(10)
+    print(f"SIGKILLed cell {victim} mid-run")
+    for _ in range(3):
+        client.async_send_grad()
+        client.wait()
+        time.sleep(0.1)
+
+    for t in rth:
+        t.join(300)
+        assert not t.is_alive(), "reader hung after the cell kill"
+    client.stop()
+    sth.join(120)
+    assert not sth.is_alive(), "server never stopped (dead cell wedged it?)"
+    procs[cell_ranks[1]].join(60)
+    assert procs[cell_ranks[1]].exitcode == 0, (
+        f"surviving cell exited {procs[cell_ranks[1]].exitcode}")
+
+    assert not failures, failures  # zero RetryExhausted, zero errors
+    failovers = sum(s["failovers"] for s in stats.values())
+    assert failovers >= 1, "nobody was routed to the killed cell?"
+    total_reads = 0
+    for rank, s in stats.items():
+        assert s["monotone"], f"reader {rank} versions went backwards"
+        assert len(s["reads"]) == ROUNDS, f"reader {rank} lost reads"
+        for version, lag, mirror in s["reads"]:
+            total_reads += 1
+            expect = param + float(max(version - 1, 0))
+            assert np.array_equal(mirror, expect), (
+                f"reader {rank} bytes differ at version {version}")
+            assert lag <= MAX_LAG, (
+                f"reader {rank} served {lag} behind head (bound {MAX_LAG})")
+    evictions = int(server._m_evictions.value)
+    assert evictions >= 1, "the killed cell was never evicted by lease"
+
+    # The failover left a postmortem with the version window.
+    dumps = [f for f in os.listdir(flight_dir) if "cell_failover" in f]
+    assert dumps, f"no cell_failover flight dump in {flight_dir}"
+    report = obs_flight.validate_dump(os.path.join(flight_dir, dumps[0]))
+    assert report["reason"] == "cell_failover"
+
+    for r in (0, 1):
+        tr[r].close()
+    obs_trace.write_rank_trace(trace_path, 0, role="multicell_smoke")
+    tr_report = obs_trace.validate_trace(trace_path)
+    print(f"multicell-smoke OK: {NREADERS} readers x {ROUNDS} reads "
+          f"({total_reads} bitwise-checked), failovers={failovers}, "
+          f"evictions={evictions}, flight dumps={len(dumps)}, trace "
+          f"events={tr_report.get('events')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(
+        sys.argv[1] if len(sys.argv) > 1 else
+        "/tmp/mpit_multicell_smoke_trace.json",
+        sys.argv[2] if len(sys.argv) > 2 else "/tmp/mpit_multicell_flight"))
