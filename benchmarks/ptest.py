@@ -187,6 +187,26 @@ ELASTIC_MBS = float(os.environ.get("MPIT_BENCH_ELASTIC_MBS", "300"))
 # elastic rows.  Both legs must end bitwise-identical (asserted
 # in-bench: the loop must not cost correctness to buy throughput).
 AUTOSCALE_SWEEP = os.environ.get("MPIT_BENCH_AUTOSCALE", "") not in ("", "0")
+# MPIT_BENCH_STREAM=1: the pipelined-streaming A/B (ISSUE 13,
+# docs/PROTOCOL.md §12) — per codec, a 1-server/1-client framed gang
+# over a MODELED serial link (ft/faults.py PacedTransport at
+# MPIT_BENCH_STREAM_LINK_MBS) runs the 640 MB round loop twice:
+# whole-frame transfers (the unchunked control), then FLAG_CHUNKED
+# streaming at MPIT_BENCH_STREAM_CHUNK_MB chunks.  Each GRAD and PARAM
+# op is individually timed; the rows carry per-op p50 next to the
+# aggregate, and the chunked row records its GRAD speedup over the
+# control (bar: >= 1.5x on the 640 MB leg).  The link model exists for
+# the same reason the elastic sweep's member-capacity throttle does:
+# on a time-shared 1-core bench host, loopback "wire" time IS host CPU
+# time, so an unmodeled A/B measures scheduling, not transfer
+# pipelining — with the link modeled, overlap buys exactly the time a
+# real network would hide.  Rows are tagged metric=ps_stream_pipeline
+# and never join the codec=none baseline gate (a modeled link is not
+# the record's wire).
+STREAM_SWEEP = os.environ.get("MPIT_BENCH_STREAM", "") not in ("", "0")
+STREAM_LINK_MBS = float(os.environ.get("MPIT_BENCH_STREAM_LINK_MBS", "800"))
+STREAM_CHUNK_MB = float(os.environ.get("MPIT_BENCH_STREAM_CHUNK_MB", "8"))
+STREAM_DEADLINE = float(os.environ.get("MPIT_BENCH_STREAM_DEADLINE", "600"))
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
 # (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
@@ -401,6 +421,71 @@ def bench_autoscale() -> list:
     return rows
 
 
+def bench_stream() -> list:
+    """The pipelined-streaming A/B (MPIT_BENCH_STREAM, §12.7): per
+    codec, the unchunked control then the FLAG_CHUNKED leg, both as a
+    1-server/1-client framed gang over the modeled serial link.  The
+    chunked row records its GRAD p50 speedup over the control — the
+    ISSUE 13 bar is >= 1.5x at 640 MB."""
+    import numpy as np
+
+    global NSERVERS, NCLIENTS
+    saved = (NSERVERS, NCLIENTS)
+    NSERVERS = NCLIENTS = 1
+    size = int(MB * (1 << 20) / 4)
+    chunk_bytes = int(STREAM_CHUNK_MB * (1 << 20))
+    rows = []
+    try:
+        for codec in (CODECS or ["none"]):
+            os.environ["MPIT_PS_CODEC"] = codec or "none"
+            pair = {}
+            for chunked in (0, 1):
+                spec = {"chunk_bytes": chunk_bytes if chunked else 0,
+                        "link_mbs": STREAM_LINK_MBS,
+                        "deadline_s": STREAM_DEADLINE}
+                out: dict = {}
+                _log(f"[stream] codec {codec or 'none'} "
+                     f"{'chunked' if chunked else 'control'}: 1s/1c, "
+                     f"link {STREAM_LINK_MBS:.0f} MB/s, payload "
+                     f"{size * 4 / 2**20:.0f} MB"
+                     + (f", {STREAM_CHUNK_MB:.0f} MB chunks"
+                        if chunked else ""))
+                mbs = _shm_run_procs(size, stream=spec, stream_out=out)
+                gp50 = float(np.percentile(out["lat_grad"], 50)) * 1e3
+                pp50 = float(np.percentile(out["lat_param"], 50)) * 1e3
+                row = {
+                    "metric": "ps_stream_pipeline",
+                    "unit": "ms",
+                    "value": round(gp50, 1),
+                    "codec": codec or "none",
+                    "stream": chunked,
+                    "grad_p50_ms": round(gp50, 1),
+                    "param_p50_ms": round(pp50, 1),
+                    "aggregate_mbs": round(mbs, 1),
+                    "link_mbs": STREAM_LINK_MBS,
+                    "chunk_mb": STREAM_CHUNK_MB if chunked else 0,
+                    "payload_mb": round(size * 4 / 2**20, 1),
+                    "rounds": ROUNDS,
+                    "retries": out.get("retries", 0),
+                }
+                rows.append(row)
+                pair[chunked] = row
+            speedup = (pair[0]["grad_p50_ms"]
+                       / max(pair[1]["grad_p50_ms"], 1e-9))
+            pair[1]["grad_speedup"] = round(speedup, 2)
+            pair[1]["param_speedup"] = round(
+                pair[0]["param_p50_ms"]
+                / max(pair[1]["param_p50_ms"], 1e-9), 2)
+            _log(f"[stream] codec {codec or 'none'}: GRAD p50 "
+                 f"{pair[0]['grad_p50_ms']:.0f} -> "
+                 f"{pair[1]['grad_p50_ms']:.0f} ms ({speedup:.2f}x), "
+                 f"PARAM p50 {pair[0]['param_p50_ms']:.0f} -> "
+                 f"{pair[1]['param_p50_ms']:.0f} ms")
+    finally:
+        NSERVERS, NCLIENTS = saved
+    return rows
+
+
 _GANG_SEQ = [0]  # unique shm namespace per gang within this process
 
 
@@ -436,7 +521,8 @@ def _status_poller(port: int, stop, polls) -> None:
 def _shm_run_procs(size: int, heartbeat: bool = False,
                    obs: bool = False, skew_rebalance=None,
                    status_port=None, status_polls=None,
-                   decomp_out=None, throttle_mbs: float = 0.0) -> float:
+                   decomp_out=None, throttle_mbs: float = 0.0,
+                   stream=None, stream_out=None) -> float:
     """One timed gang, one OS process per rank: servers run the PS serve
     loop, clients run T rounds of {pull, push, wait} and report their
     round-loop window; aggregate MB/s uses the union of the client
@@ -457,6 +543,8 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
     }
     if throttle_mbs > 0:
         spec["throttle_mbs"] = throttle_mbs
+    if stream is not None:
+        spec["stream"] = stream
     if decomp_out is not None:
         # Causal-tracing leg: the framed FLAG_TIMING wire (generous
         # deadline — a spurious retry at bench scale would corrupt the
@@ -542,6 +630,13 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
         with open(result_files[rank]) as fh:
             rec = json.load(fh)
         windows.append((rec["t0"], rec["t1"]))
+        if stream_out is not None:
+            stream_out.setdefault("lat_grad", []).extend(
+                rec.get("lat_grad", []))
+            stream_out.setdefault("lat_param", []).extend(
+                rec.get("lat_param", []))
+            stream_out["retries"] = stream_out.get("retries", 0) + int(
+                rec.get("retries", 0))
     dt = max(w[1] for w in windows) - min(w[0] for w in windows)
     if decomp_out is not None:
         decomp_out.clear()
@@ -630,6 +725,7 @@ def _gang_child() -> None:
     spec = json.loads(os.environ["PTEST_GANG"])
     rank = int(os.environ["PTEST_RANK"])
     skew = spec.get("skew")
+    stream = spec.get("stream")
     nranks = spec["nservers"] + spec["nclients"] + (1 if skew else 0)
     sranks = list(range(spec["nservers"]))
     cranks = list(range(spec["nservers"],
@@ -665,8 +761,23 @@ def _gang_child() -> None:
         client_ft = FTConfig(op_deadline_s=float(skew["deadline_s"]),
                              max_retries=8)
         server_ft = FTConfig(heartbeat_s=0.05)
+    if stream:
+        # Streaming A/B (§12.7): framed wire, chunked or not per the
+        # leg; a generous deadline — this column measures pipelining,
+        # not the retry machinery.
+        client_ft = FTConfig(op_deadline_s=float(stream["deadline_s"]),
+                             max_retries=2,
+                             chunk_bytes=int(stream["chunk_bytes"]))
     transport = ShmTransport(spec["ns"], rank, nranks,
                              ring_bytes=spec["ring"])
+    if stream and float(stream.get("link_mbs", 0)) > 0:
+        # The modeled serial link, both directions (see the
+        # MPIT_BENCH_STREAM comment at the top of this file): big
+        # frames transit at link_mbs; control traffic passes.
+        from mpit_tpu.ft import PacedTransport
+
+        transport = PacedTransport(transport, float(stream["link_mbs"]),
+                                   min_bytes=1 << 14)
     # Startup barrier: no PS traffic until every ring is mapped (the
     # mpirun-gives-you-this guarantee, same as train/gang.py).
     HostCollectives(transport).barrier()
@@ -737,13 +848,34 @@ def _gang_child() -> None:
                 client.ping()
             transport.recv(cranks[0], _SYNC_TAG)
         t0 = time.time()
-        for _ in range(spec["rounds"]):
-            client.async_recv_param()
-            client.async_send_grad()
-            client.wait()
-        t1 = time.time()
-        client.stop()
-        result = {"role": "client", "t0": t0, "t1": t1}
+        if stream:
+            # Per-op timing (the §12.7 A/B's payload): each GRAD and
+            # each PARAM read individually, serial — the pipelining
+            # under test is WITHIN one op, and concurrent ops would
+            # fold cross-op scheduling into the measured latency.
+            lat_grad, lat_param = [], []
+            for _ in range(spec["rounds"]):
+                s = time.monotonic()
+                client.async_send_grad()
+                client.wait()
+                lat_grad.append(time.monotonic() - s)
+                s = time.monotonic()
+                client.async_recv_param()
+                client.wait()
+                lat_param.append(time.monotonic() - s)
+            t1 = time.time()
+            client.stop()
+            result = {"role": "client", "t0": t0, "t1": t1,
+                      "lat_grad": lat_grad, "lat_param": lat_param,
+                      "retries": client.retries}
+        else:
+            for _ in range(spec["rounds"]):
+                client.async_recv_param()
+                client.async_send_grad()
+                client.wait()
+            t1 = time.time()
+            client.stop()
+            result = {"role": "client", "t0": t0, "t1": t1}
     # Per-rank Chrome-trace part (no-op unless MPIT_OBS_TRACE rode in —
     # the MPIT_BENCH_DECOMP column); the parent merges + analyzes.
     from mpit_tpu.obs import maybe_write_rank_trace
@@ -1499,6 +1631,11 @@ def main():
         killable = [n for n in CELLS_SWEEP if n >= 2]
         if CELL_KILL and killable:
             results.append(bench_cells(max(killable), kill=True))
+    if STREAM_SWEEP and MODE in ("shm", "both"):
+        # The pipelined-streaming A/B: per codec, unchunked control vs
+        # FLAG_CHUNKED over the modeled serial link.  Latency-metric
+        # rows on a modeled wire: never join the codec=none gate.
+        results.extend(bench_stream())
     if SKEW_SWEEP and MODE in ("shm", "both"):
         # The straggler A/B runs at codec=none (the skew is in the
         # *reply latency*, not the byte volume): rebalance off, then on.

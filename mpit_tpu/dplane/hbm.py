@@ -88,14 +88,23 @@ def place_flat(arr, cfg: Optional[PlaneConfig]):
 _identity_copy = None
 
 
-def _device_copy(x):
-    """A bit-exact fresh buffer for ``x`` (jitted identity: jax/XLA
-    never alias an un-donated output to its input, verified by the
-    donation tests).  Stays on device; preserves sharding."""
+def device_copy(x):
+    """A bit-exact fresh *device-owned* buffer for ``x`` (jitted
+    identity: jax/XLA never alias an un-donated output to its input,
+    verified by the donation tests).  Stays on device; preserves
+    sharding.  Also the donation-safety helper: ``jnp.asarray`` of an
+    aligned numpy array ALIASES the numpy memory on the CPU backend,
+    and donating a numpy-backed buffer hands XLA memory it does not
+    own — heap corruption, observed as flaky aborts.  Everything that
+    enters a donated apply chain must pass through here first."""
     global _identity_copy
     if _identity_copy is None:
         _identity_copy = jax.jit(lambda v: v)
     return _identity_copy(x)
+
+
+#: back-compat internal alias
+_device_copy = device_copy
 
 
 def dedupe_state(state):
@@ -117,10 +126,16 @@ def dedupe_state(state):
 def place_state(state, cfg: Optional[PlaneConfig]):
     """Place a rule-state pytree next to its param: flat arrays follow
     the param's sharding, scalars replicate.  Always de-aliased — see
-    :func:`dedupe_state`."""
+    :func:`dedupe_state` — and numpy-backed leaves are re-owned on
+    device (:func:`device_copy`): restored/migrated state feeds
+    donated applies, which must never consume numpy-owned memory."""
+    def own(v):
+        placed = jnp.asarray(v)
+        return device_copy(placed) if isinstance(v, np.ndarray) else placed
+
     if cfg is None or cfg.mesh is None:
         return dedupe_state(
-            {k: jnp.asarray(v) for k, v in (state or {}).items()})
+            {k: own(v) for k, v in (state or {}).items()})
 
     def put(v):
         shape = np.shape(v)
@@ -142,7 +157,12 @@ class HbmSlot:
         self.dtype = np.dtype(dtype)
         self.config = config or PlaneConfig()
         self.rank = rank
-        self.param = place_flat(np.zeros(self.size, self.dtype), self.config)
+        # device_copy: place_flat aliases the aligned numpy zeros on
+        # the CPU backend, and the donated applies must never consume
+        # numpy-backed memory (use-after-free once the alias's base
+        # drops — see device_copy).
+        self.param = device_copy(
+            place_flat(np.zeros(self.size, self.dtype), self.config))
         self.rule_state = dedupe_state(rule.init(self.param))
         #: committed version: bumps on every apply/seed (the snapshot
         #: cache key, same meaning as the server's _snap_version)
@@ -185,6 +205,58 @@ class HbmSlot:
             self._fused[key] = fn
         return fn
 
+    def _fused_chunk_apply(self, codec, csize: int) -> Callable:
+        """The jitted per-chunk update for streamed transfers
+        (docs/PROTOCOL.md §12): decode the chunk frame, slice the
+        ``csize`` window out of param + every (param-shaped) state
+        leaf, run ``rule.apply`` on the slices, write both back with
+        ``dynamic_update_slice`` — one donated XLA call per chunk, so
+        the update of chunk k runs while chunk k+1 is on the wire.
+        ``lo`` is a traced scalar: one compiled program per (codec,
+        chunk size), not per offset.  Bit-equality to the whole-shard
+        apply holds exactly because every supported rule is
+        element-wise over (param, grad, state) — the server's
+        negotiation rejects chunking for rules with scalar state."""
+        key = (codec.name if codec is not None else None, csize)
+        fn = self._fused.get(("chunk",) + key)
+        if fn is None:
+            rule_apply = self.rule.apply
+
+            def body(param, payload, state, lo):
+                g = (payload if codec is None or codec.identity
+                     else codec.decode_parts(payload, csize))
+                psl = jax.lax.dynamic_slice(param, (lo,), (csize,))
+                ssl = {k: jax.lax.dynamic_slice(v, (lo,), (csize,))
+                       for k, v in state.items()}
+                pn, sn = rule_apply(psl, g, ssl)
+                return (jax.lax.dynamic_update_slice(param, pn, (lo,)),
+                        {k: jax.lax.dynamic_update_slice(state[k], sn[k],
+                                                         (lo,))
+                         for k in state})
+
+            donate = (0, 2) if self.config.donate else ()
+            fn = jax.jit(body, donate_argnums=donate)
+            self._fused[("chunk",) + key] = fn
+        return fn
+
+    def apply_wire_chunk(self, codec, grad_in, lo: int, csize: int,
+                         commit: bool = True) -> None:
+        """Apply one wire-format *chunk* at element offset ``lo``:
+        ``grad_in`` is the chunk's decoded host view (identity codecs)
+        or its split wire parts.  ``commit`` bumps the version exactly
+        once per op — on the final chunk — so snapshot caches and the
+        diff stream keep op-granular version arithmetic."""
+        if codec is None or codec.identity:
+            payload: Any = jnp.asarray(grad_in)
+        else:
+            payload = [jnp.asarray(v) for v in grad_in]
+        fn = self._fused_chunk_apply(codec, csize)
+        self.param, self.rule_state = fn(self.param, payload,
+                                         self.rule_state, np.int32(lo))
+        if commit:
+            self._m_applies.inc()
+            self._invalidate()
+
     def _invalidate(self) -> None:
         self.version += 1
         self._pull_cache = None
@@ -216,8 +288,10 @@ class HbmSlot:
     def seed(self, value) -> None:
         """Whole-shard write (seeding / PARAM_PUSH): re-place, new
         version.  Rule state is deliberately kept — the reference's
-        seed overwrites params only."""
-        self.param = place_flat(value, self.config)
+        seed overwrites params only.  The placed array is re-owned on
+        device (:func:`device_copy`) — a numpy-aliased param entering
+        this slot's donated applies would corrupt the heap."""
+        self.param = device_copy(place_flat(value, self.config))
         self._invalidate()
 
     # -- read path: per-version caches on both sides of the boundary --------

@@ -26,7 +26,12 @@ existing layers:
 from mpit_tpu.ft.config import FTConfig
 from mpit_tpu.ft.dedup import DUP, FRESH, STALE, DedupTable
 from mpit_tpu.ft.elastic import ElasticDirectory, PreemptionNotice
-from mpit_tpu.ft.faults import FaultPlan, FaultyTransport, inject_preemption
+from mpit_tpu.ft.faults import (
+    FaultPlan,
+    FaultyTransport,
+    PacedTransport,
+    inject_preemption,
+)
 from mpit_tpu.ft.leases import (
     ACTIVE,
     EVICTED,
@@ -38,6 +43,11 @@ from mpit_tpu.ft.retry import RetryExhausted, RetryPolicy
 from mpit_tpu.ft.traffic import Scenario, TrafficEvent, TrafficPhase
 from mpit_tpu.ft.wire import (
     ACK_TIMING_WORDS,
+    CHUNK_ACK_TIMING_WORDS,
+    CHUNK_ACK_WORDS,
+    CHUNK_HDR_BYTES,
+    CHUNK_REPLY_WORDS,
+    FLAG_CHUNKED,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
     FLAG_READONLY,
@@ -47,15 +57,26 @@ from mpit_tpu.ft.wire import (
     HDR_BYTES,
     HDR_STALE_BYTES,
     TIMING_TAIL_BYTES,
+    chunk_ack_frame,
+    chunk_elems_for,
+    chunk_hdr_bytes,
+    chunk_reply_hdr_bytes,
+    chunk_spans,
+    chunk_stride,
     hdr_bytes,
     header_frame,
     init_v3,
+    init_v5,
+    pack_chunk_header,
+    pack_chunk_reply,
     pack_header,
     pack_reply_stamps,
     pack_tx_stamp,
     pack_version,
     reply_hdr_bytes,
     timed_frame,
+    unpack_chunk_header,
+    unpack_chunk_reply,
     unpack_header,
     unpack_reply_stamps,
     unpack_tx_stamp,
@@ -65,14 +86,20 @@ from mpit_tpu.ft.wire import (
 __all__ = [
     "FTConfig",
     "DedupTable", "FRESH", "DUP", "STALE",
-    "FaultPlan", "FaultyTransport", "inject_preemption",
+    "FaultPlan", "FaultyTransport", "PacedTransport", "inject_preemption",
     "PreemptionNotice", "ElasticDirectory",
     "LeaseRegistry", "ACTIVE", "EVICTED", "STOPPED", "RETIRED",
     "RetryPolicy", "RetryExhausted",
     "Scenario", "TrafficPhase", "TrafficEvent",
     "HDR_BYTES", "HDR_STALE_BYTES",
     "FLAG_FRAMED", "FLAG_HEARTBEAT", "FLAG_READONLY", "FLAG_STALENESS",
-    "FLAG_SUBSCRIBE", "FLAG_TIMING",
+    "FLAG_SUBSCRIBE", "FLAG_TIMING", "FLAG_CHUNKED",
+    "CHUNK_HDR_BYTES", "CHUNK_ACK_WORDS", "CHUNK_ACK_TIMING_WORDS",
+    "CHUNK_REPLY_WORDS",
+    "chunk_elems_for", "chunk_spans", "chunk_stride", "chunk_hdr_bytes",
+    "chunk_reply_hdr_bytes", "pack_chunk_header", "unpack_chunk_header",
+    "pack_chunk_reply", "unpack_chunk_reply", "chunk_ack_frame",
+    "init_v5",
     "ACK_TIMING_WORDS", "TIMING_TAIL_BYTES",
     "hdr_bytes", "reply_hdr_bytes",
     "pack_header", "unpack_header", "header_frame", "timed_frame",

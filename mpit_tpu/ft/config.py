@@ -58,6 +58,13 @@ class FTConfig:
     #: framing; silently inactive otherwise, negotiated off per pair for
     #: legacy peers exactly like staleness.
     timing: bool = False
+    #: client: announce FLAG_CHUNKED — ship each GRAD / PARAM /
+    #: PARAM_PUSH body as a pipelined stream of ~this-many-byte chunk
+    #: frames (block-aligned; ft/wire.py chunk_elems_for) so encode,
+    #: wire and apply overlap on the transfer-bound hot path
+    #: (PROTOCOL.md §12).  Requires framing (retry resends missing
+    #: chunks; dedup is per (op, chunk)); 0 keeps whole-frame transfers.
+    chunk_bytes: int = 0
 
     @property
     def active(self) -> bool:
@@ -79,6 +86,13 @@ class FTConfig:
     def timing_track(self) -> bool:
         """Causal-timing telemetry is live: framed + requested."""
         return self.framed and self.timing
+
+    @property
+    def chunked(self) -> bool:
+        """Pipelined streaming transfers are live: framed + a chunk
+        size.  Chunking IS the retry machinery restructured — without
+        deadlines there is no per-chunk resend path to ride."""
+        return self.framed and self.chunk_bytes > 0
 
     @property
     def server_rejoin(self) -> bool:
@@ -106,6 +120,7 @@ class FTConfig:
             staleness=os.environ.get("MPIT_FT_STALENESS", "0")
             not in ("0", ""),
             timing=os.environ.get("MPIT_FT_TIMING", "0") not in ("0", ""),
+            chunk_bytes=int(_f("MPIT_FT_CHUNK_BYTES", 0)),
         )
         fields.update(overrides)
         return cls(**fields)

@@ -285,3 +285,99 @@ class FaultyTransport(Transport):
 
     def close(self) -> None:
         self.inner.close()
+
+
+class PacedTransport(Transport):
+    """A store-and-forward *link model*: every outbound message to a peer
+    transits a serial link of ``rate_mbs`` megabytes/second, so a
+    message becomes visible to the receiver only after every earlier
+    message on that link has finished transmitting plus its own
+    ``nbytes / rate`` of link time.  The sender is never blocked — the
+    post is deferred, not slept — which is exactly what makes pipeline
+    overlap measurable: while one chunk occupies the modeled link, the
+    sender's core is free to encode the next one and the receiver's to
+    apply the previous one.
+
+    This is a *model*, not a fault plan: it exists for the streaming
+    bench/smoke legs (docs/PROTOCOL.md §12.7), the same role the
+    member-capacity throttle plays for the elastic sweeps — on a
+    time-shared bench host an unmodeled loopback "wire" is a memcpy
+    whose cost is indistinguishable from compute, so the A/B would
+    measure host scheduling, not transfer pipelining.  Receives,
+    probes and small control traffic (``min_bytes``) pass untouched.
+    """
+
+    def __init__(self, inner: Transport, rate_mbs: float,
+                 min_bytes: int = 4096,
+                 tags: "Optional[frozenset]" = None):
+        self.inner = inner
+        self.rank = inner.rank
+        self.nranks = inner.nranks
+        self.rate = float(rate_mbs) * (1 << 20)
+        self.min_bytes = int(min_bytes)
+        self.tags = tags
+        #: dst -> monotonic time the modeled link to it frees up
+        self._free: dict = {}
+        #: dst -> deque of (due, data, tag, proxy Handle) awaiting post
+        self._queued: dict = {}
+
+    def _pump(self) -> None:
+        """Post every queued message whose link time elapsed (called
+        from every test/iprobe — the same progress discipline the shm
+        transport uses)."""
+        now = _time.monotonic()
+        for dst, queue in self._queued.items():
+            while queue and queue[0][0] <= now:
+                _due, data, tag, proxy = queue.pop(0)
+                if proxy.cancelled:
+                    continue
+                proxy.meta["inner"] = self.inner.isend(data, dst, tag)
+                proxy.buf = None  # inner handle owns liveness now
+
+    def isend(self, data: Any, dst: int, tag: int) -> Handle:
+        nbytes = int(getattr(data, "nbytes", None) or len(data or b""))
+        if (tag < 0 or nbytes < self.min_bytes
+                or (self.tags is not None and tag not in self.tags)):
+            return self.inner.isend(data, dst, tag)
+        now = _time.monotonic()
+        due = max(now, self._free.get(dst, now)) + nbytes / self.rate
+        self._free[dst] = due
+        proxy = Handle(kind="send", peer=dst, tag=tag, buf=data,
+                       meta={"paced": True})
+        self._queued.setdefault(dst, []).append((due, data, tag, proxy))
+        return proxy
+
+    def test(self, handle: Handle) -> bool:
+        self._pump()
+        if not handle.meta.get("paced"):
+            return self.inner.test(handle)
+        if handle.cancelled:
+            return False
+        inner = handle.meta.get("inner")
+        if inner is None:
+            return False  # still on the modeled link
+        if self.inner.test(inner):
+            handle.done = True
+        return handle.done
+
+    def cancel(self, handle: Handle) -> None:
+        if not handle.meta.get("paced"):
+            return self.inner.cancel(handle)
+        inner = handle.meta.get("inner")
+        if inner is not None:
+            self.inner.cancel(inner)
+        handle.cancelled = True
+        handle.buf = None
+
+    def iprobe(self, src: int, tag: int) -> bool:
+        self._pump()
+        return self.inner.iprobe(src, tag)
+
+    def irecv(self, src: int, tag: int, out: Any | None = None) -> Handle:
+        return self.inner.irecv(src, tag, out=out)
+
+    def payload(self, handle: Handle) -> Any:
+        return self.inner.payload(handle)
+
+    def close(self) -> None:
+        self.inner.close()

@@ -100,6 +100,24 @@ FLAG_READONLY = 16
 #: never depends on the (possibly delayed) diff stream itself.
 FLAG_SUBSCRIBE = 32
 
+#: INIT v6 flags bit6: pipelined streaming transfers (docs/PROTOCOL.md
+#: §12).  A GRAD / PARAM / PARAM_PUSH body ships as K independent chunk
+#: frames — each its own transport message with its own
+#: ``[epoch, seq, chunk_idx, chunk_count]`` header — so the three
+#: serialized phases of a big shard op (encode, wire, apply) overlap:
+#: the server decodes+applies chunk *k* while chunk *k+1* is on the
+#: wire and the client encodes chunk *k+2* into staging.  Chunks cut on
+#: the int8 codec's 1024-element block boundaries, so each chunk frame
+#: is bit-identical to the corresponding region of the unchunked frame
+#: and the error-feedback residual folds exactly once per block.
+#: Requires FLAG_FRAMED (retry resends *missing chunks only*, dedup is
+#: per (op, chunk)); announced via INIT v5 (48 bytes — the chunk size
+#: travels in the announcement); negotiates FLAG_STALENESS off (the
+#: chunked PARAM reply header carries the version in its own word) and
+#: composes with FLAG_TIMING; off under shardctl and for READONLY /
+#: SUBSCRIBE postures.
+FLAG_CHUNKED = 64
+
 #: the timing tail: int64 [t_tx_echo_us, t_recv_us, t_ack_us]
 TIMING_TAIL_WORDS = 3
 TIMING_TAIL_BYTES = 8 * TIMING_TAIL_WORDS
@@ -107,6 +125,21 @@ TIMING_TAIL_BYTES = 8 * TIMING_TAIL_WORDS
 #: timing acks (GRAD_ACK / PARAM_PUSH_ACK / HEARTBEAT_ECHO): int64
 #: [epoch, seq, t_tx_echo, t_recv, t_ack]
 ACK_TIMING_WORDS = 5
+
+#: chunked data-frame header: int64 [epoch, seq, chunk_idx, chunk_count]
+CHUNK_HDR_BYTES = 32
+
+#: chunked acks: int64 [epoch, seq, chunk_idx] — one ack per admitted
+#: chunk, which is what lets a retry resend only the chunks whose acks
+#: never arrived.  FLAG_TIMING appends the usual three-word tail.
+CHUNK_ACK_WORDS = 3
+CHUNK_ACK_TIMING_WORDS = CHUNK_ACK_WORDS + TIMING_TAIL_WORDS
+
+#: chunked PARAM replies: int64 [epoch, seq, chunk_idx, chunk_count,
+#: version] — every chunk stamps the snapshot version it was cut from,
+#: so the client assembles exactly one version even when a retried
+#: request is answered at a newer head (§12.4).
+CHUNK_REPLY_WORDS = 5
 
 
 def hdr_bytes(stale: bool, timing: bool) -> int:
@@ -187,3 +220,95 @@ def init_v3(offset: int, size: int, codec_id: int, epoch: int,
             flags: int) -> np.ndarray:
     """The 40-byte INIT v3 announcement payload."""
     return np.asarray([offset, size, codec_id, epoch, flags], dtype=np.int64)
+
+
+def init_v5(offset: int, size: int, codec_id: int, epoch: int, flags: int,
+            chunk_elems: int) -> np.ndarray:
+    """The 48-byte INIT v5 announcement: v3 plus the chunk cut (elements
+    per chunk) for FLAG_CHUNKED pairs — both sides must derive identical
+    chunk layouts, so the cut travels in the announcement."""
+    return np.asarray([offset, size, codec_id, epoch, flags, chunk_elems],
+                      dtype=np.int64)
+
+
+# -- chunked streaming (FLAG_CHUNKED, docs/PROTOCOL.md §12) ------------------
+
+#: chunk cuts land on the int8 codec's quantization-block boundaries so
+#: each chunk is an independent codec frame bit-identical to the same
+#: region of the unchunked frame (comm/codec.py BLOCK).
+CHUNK_BLOCK = 1024
+
+
+def chunk_elems_for(chunk_bytes: int, itemsize: int) -> int:
+    """The block-aligned chunk cut (in elements) for a requested chunk
+    size in bytes: floor to a CHUNK_BLOCK multiple, never below one
+    block.  Pure function of (bytes, dtype) — both sides agree because
+    the client announces the result, not the request."""
+    elems = max(int(chunk_bytes) // int(itemsize), CHUNK_BLOCK)
+    return max(elems // CHUNK_BLOCK, 1) * CHUNK_BLOCK
+
+
+def chunk_spans(size: int, chunk_elems: int):
+    """The [lo, hi) element spans of a ``size``-element shard cut at
+    ``chunk_elems``: every span but the last is exactly chunk_elems and
+    starts on a block boundary; the last takes the remainder."""
+    if size <= 0:
+        return [(0, 0)]
+    return [(lo, min(lo + chunk_elems, size))
+            for lo in range(0, size, chunk_elems)]
+
+
+def chunk_stride(hdr: int, body: int) -> int:
+    """The uniform per-chunk frame size for a (header, full-chunk body)
+    pair, rounded up to 64 bytes: every chunk message — the last one
+    padded — is exactly this long, so both sides receive into
+    fixed-size staging and every embedded int64/float32 view stays
+    aligned whatever the codec's frame arithmetic produced."""
+    return (hdr + body + 63) // 64 * 64
+
+
+def chunk_hdr_bytes(timing: bool) -> int:
+    """Chunked data-frame header size: [epoch, seq, chunk_idx,
+    chunk_count] (+ the t_tx stamp, always the last word, under
+    FLAG_TIMING — pack_tx_stamp/unpack_tx_stamp work unchanged)."""
+    return CHUNK_HDR_BYTES + (8 if timing else 0)
+
+
+def chunk_reply_hdr_bytes(timing: bool) -> int:
+    """Chunked PARAM-reply header size: [epoch, seq, chunk_idx,
+    chunk_count, version] (+ the three-word timing tail)."""
+    return 8 * CHUNK_REPLY_WORDS + (TIMING_TAIL_BYTES if timing else 0)
+
+
+def pack_chunk_header(buf: np.ndarray, epoch: int, seq: int, idx: int,
+                      count: int) -> None:
+    """Write the chunked data-frame header into the first CHUNK_HDR_BYTES
+    of a uint8 staging frame."""
+    buf[:CHUNK_HDR_BYTES].view(np.int64)[:] = (epoch, seq, idx, count)
+
+
+def unpack_chunk_header(buf: np.ndarray) -> Tuple[int, int, int, int]:
+    """(epoch, seq, chunk_idx, chunk_count) from a chunked data frame."""
+    hdr = buf[:CHUNK_HDR_BYTES].view(np.int64)
+    return int(hdr[0]), int(hdr[1]), int(hdr[2]), int(hdr[3])
+
+
+def pack_chunk_reply(buf: np.ndarray, epoch: int, seq: int, idx: int,
+                     count: int, version: int) -> None:
+    """Write the chunked PARAM-reply header (the version word makes
+    cross-retry assembly single-version, §12.4)."""
+    buf[:8 * CHUNK_REPLY_WORDS].view(np.int64)[:] = (
+        epoch, seq, idx, count, version)
+
+
+def unpack_chunk_reply(buf: np.ndarray) -> Tuple[int, int, int, int, int]:
+    """(epoch, seq, chunk_idx, chunk_count, version) from a chunked
+    PARAM reply."""
+    hdr = buf[:8 * CHUNK_REPLY_WORDS].view(np.int64)
+    return (int(hdr[0]), int(hdr[1]), int(hdr[2]), int(hdr[3]),
+            int(hdr[4]))
+
+
+def chunk_ack_frame(epoch: int, seq: int, idx: int) -> np.ndarray:
+    """A fresh 24-byte chunk ack (non-timing pairs)."""
+    return np.asarray([epoch, seq, idx], dtype=np.int64)

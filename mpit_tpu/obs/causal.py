@@ -57,8 +57,8 @@ from mpit_tpu.obs.clock import PeerClock
 #: ``client_wait`` is the residual that makes the decomposition sum to
 #: the op's client wall time (decode, scheduler resumption latency, and
 #: whatever clock error the uncertainty bound absorbs).
-PHASES = ("encode", "send_queue", "wire", "server_queue", "apply",
-          "ack_wire", "retry", "client_wait")
+PHASES = ("encode", "send_queue", "wire", "stream", "server_queue",
+          "apply", "ack_wire", "retry", "client_wait")
 
 #: ops the joiner considers (framed PS data ops; MIGRATE spans carry no
 #: [epoch, seq] and are not point-to-point client ops).
@@ -225,11 +225,13 @@ def join_spans(spans: List[Span]) -> Tuple[List[Chain], List[Span]]:
 # -- clock alignment ---------------------------------------------------------
 
 
-def _send_complete_ts(client: Span) -> Optional[float]:
-    """When the successful attempt's frame left the client: the end of
-    the last ``send`` phase (aio_send completed; the following mark is
-    the ack/recv wait)."""
-    for name, ts, dur in reversed(client.phases):
+def _send_complete_ts(client: Span, last: bool = True) -> Optional[float]:
+    """When an attempt's frame left the client: the end of the last
+    (or first) ``send`` phase (aio_send completed; the following mark
+    is the ack/recv wait — or the first ``chunk`` post for streamed
+    ops)."""
+    marks = reversed(client.phases) if last else client.phases
+    for name, ts, dur in marks:
         if name == "send":
             return ts + dur
     return None
@@ -364,6 +366,7 @@ def decompose(chain: Chain, offsets: OffsetTable) -> Optional[dict]:
     ack_done = _ack_done_ts(client)
     if last_send is not None and send_done is not None:
         raw["send_queue"] = send_done - last_send
+    chunked = int(client.args.get("chunks", 0) or 0) >= 2
     if server is not None:
         offset, unc, source = offsets.lookup(
             _client_rank(chain), _server_rank(chain))
@@ -373,21 +376,45 @@ def decompose(chain: Chain, offsets: OffsetTable) -> Optional[dict]:
                      else srv_t0)
         srv_last = (server.phases[-1][1] - offset if server.phases
                     else server.t1 - offset)
-        if send_done is not None:
-            # The send-queue/wire boundary is the causal handoff: the
-            # server can legitimately *receive* the frame before the
-            # client's cooperative scheduler observes its own send
-            # completion (shm ring handoff + poll latency), so the
-            # boundary is min(send-complete, server-receive).  Only
-            # server-receive preceding the send *start* breaks
-            # causality — that is what the violation check catches.
-            handoff = min(send_done, srv_t0)
-            raw["wire"] = srv_t0 - handoff
-            if last_send is not None:
-                raw["send_queue"] = handoff - last_send
-        raw["server_queue"] = srv_first - srv_t0
-        raw["apply"] = srv_last - srv_first
-        raw["ack_wire"] = ack_done - srv_last
+        if chunked:
+            # Streamed op (§12): after chunk 0 reaches the server, the
+            # transfer, the per-chunk applies, the client's remaining
+            # encodes — and any chunk resends — all run CONCURRENTLY,
+            # so they cannot be summed as disjoint serial phases.  The
+            # serial skeleton is: chunk-0 encode → chunk-0 handoff →
+            # chunk-0 flight (``wire``) → the pipelined window
+            # (``stream``: first server receipt to its last mark) →
+            # the final ack's flight.  Per-chunk apply cost and the
+            # measured wire/apply concurrency live in the report's
+            # ``streaming`` section instead; ``retry`` stays 0 —
+            # chunk resends are interleaved *inside* the stream
+            # window by design (the span args still carry retries).
+            send_first = _send_complete_ts(client, last=False)
+            if send_first is not None:
+                handoff = min(send_first, srv_t0)
+                raw["wire"] = srv_t0 - handoff
+                if first_send is not None:
+                    raw["send_queue"] = handoff - first_send
+            raw["retry"] = 0.0
+            raw["stream"] = srv_last - srv_t0
+            raw["ack_wire"] = ack_done - srv_last
+        else:
+            if send_done is not None:
+                # The send-queue/wire boundary is the causal handoff:
+                # the server can legitimately *receive* the frame
+                # before the client's cooperative scheduler observes
+                # its own send completion (shm ring handoff + poll
+                # latency), so the boundary is min(send-complete,
+                # server-receive).  Only server-receive preceding the
+                # send *start* breaks causality — that is what the
+                # violation check catches.
+                handoff = min(send_done, srv_t0)
+                raw["wire"] = srv_t0 - handoff
+                if last_send is not None:
+                    raw["send_queue"] = handoff - last_send
+            raw["server_queue"] = srv_first - srv_t0
+            raw["apply"] = srv_last - srv_first
+            raw["ack_wire"] = ack_done - srv_last
     clamped = {}
     for phase in PHASES:
         value = raw[phase]
@@ -422,6 +449,45 @@ def _percentile(sorted_values: List[float], q: float) -> float:
         return 0.0
     idx = min(int(q * len(sorted_values)), len(sorted_values) - 1)
     return sorted_values[idx]
+
+
+# -- streaming overlap (FLAG_CHUNKED, docs/PROTOCOL.md §12) ------------------
+
+
+def streaming_overlap(chain: Chain,
+                      offsets: "OffsetTable") -> Optional[dict]:
+    """Phase-overlap evidence for one chunked write chain: how long the
+    server had *already been applying* chunks while this client was
+    still moving bytes.  The client marks ``flush`` when its last chunk
+    send completed (ps/client.py); the server's first ``apply`` mark is
+    when chunk 0 started folding in.  ``overlap_us = flush − aligned
+    first-apply`` — positive means wire and apply ran concurrently,
+    which is exactly the pipelining the chunked transfer exists to buy
+    (an unchunked op has the whole apply strictly after the whole
+    transfer, so this quantity is necessarily ≤ 0 there)."""
+    client, server = chain.client, chain.server
+    if client is None or server is None:
+        return None
+    chunks = int(client.args.get("chunks", 0) or 0)
+    if chunks < 2:
+        return None
+    flush = client.mark_ts("flush")
+    first_apply = server.mark_ts("apply", last=False)
+    if flush is None or first_apply is None:
+        return None
+    offset, unc, source = offsets.lookup(
+        _client_rank(chain), _server_rank(chain))
+    return {
+        "op": chain.op,
+        "client": _client_rank(chain),
+        "server": _server_rank(chain),
+        "epoch": chain.key[3],
+        "seq": chain.key[4],
+        "chunks": chunks,
+        "overlap_us": flush - (first_apply - offset),
+        "uncertainty_us": unc,
+        "offset_source": source,
+    }
 
 
 def analyze(path_or_obj, min_join: float = 0.0) -> dict:
@@ -489,6 +555,23 @@ def analyze(path_or_obj, min_join: float = 0.0) -> dict:
             "phases": phases,
             "dominant": max(PHASES, key=lambda p: phases[p]),
         }
+    # Streaming overlap (§12): chunked write chains report how much of
+    # the server's apply ran while the client was still sending — the
+    # causal decomposition's direct view of the pipeline.
+    stream_rows = [r for r in (streaming_overlap(c, offsets)
+                               for c in chains) if r is not None]
+    streaming = None
+    if stream_rows:
+        overlaps = sorted(r["overlap_us"] for r in stream_rows)
+        streaming = {
+            "ops": len(stream_rows),
+            "overlapped": sum(1 for r in stream_rows
+                              if r["overlap_us"] > 0),
+            "overlap_p50_us": _percentile(overlaps, 0.50),
+            "overlap_p90_us": _percentile(overlaps, 0.90),
+            "chunks_p50": _percentile(
+                sorted(float(r["chunks"]) for r in stream_rows), 0.50),
+        }
     slowest = sorted(joined, key=lambda d: -d["wall_us"])[:16]
     return {
         "spans": len(spans),
@@ -503,6 +586,7 @@ def analyze(path_or_obj, min_join: float = 0.0) -> dict:
         "phase_stats": stats,
         "dominant_phases": dominant,
         "critical_path": critical,
+        "streaming": streaming,
         "slowest": slowest,
         "violations": violations,
         "chains": decomposed,
@@ -606,6 +690,14 @@ def render_report(report: dict, top: int = 5) -> str:
             f"critical path: client {crit['client']} "
             f"({crit['total_us'] / 1000.0:.3f}ms attributed, "
             f"dominant phase {crit['dominant']})")
+    stream = report.get("streaming")
+    if stream:
+        lines.append(
+            f"streaming: {stream['ops']} chunked op(s), "
+            f"{stream['overlapped']} with wire/apply overlap "
+            f"(overlap p50 {stream['overlap_p50_us'] / 1000.0:.3f}ms, "
+            f"p90 {stream['overlap_p90_us'] / 1000.0:.3f}ms, "
+            f"~{stream['chunks_p50']:.0f} chunks/op)")
     for d in report["slowest"][:top]:
         decomp = "  ".join(f"{phase}={d['phases'][phase] / 1000.0:.3f}"
                            for phase in PHASES if d["phases"][phase] > 0)

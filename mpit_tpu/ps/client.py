@@ -54,6 +54,7 @@ from typing import Deque, Dict, Generator, List, Optional, Tuple
 import numpy as np
 
 from mpit_tpu.aio import (
+    EXEC,
     DeadlineExceeded,
     LiveFlag,
     Scheduler,
@@ -66,6 +67,9 @@ from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.ft import (
     ACK_TIMING_WORDS,
+    CHUNK_ACK_TIMING_WORDS,
+    CHUNK_ACK_WORDS,
+    FLAG_CHUNKED,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
     FLAG_STALENESS,
@@ -74,14 +78,22 @@ from mpit_tpu.ft import (
     FTConfig,
     RetryExhausted,
     RetryPolicy,
+    chunk_elems_for,
+    chunk_hdr_bytes,
+    chunk_reply_hdr_bytes,
+    chunk_spans,
+    chunk_stride,
     hdr_bytes,
     header_frame,
     init_v3,
+    init_v5,
+    pack_chunk_header,
     pack_header,
     pack_tx_stamp,
     pack_version,
     reply_hdr_bytes,
     timed_frame,
+    unpack_chunk_reply,
     unpack_header,
     unpack_reply_stamps,
     unpack_version,
@@ -165,7 +177,17 @@ class ParamClient:
         # Rides the framed wire (the header grows 16 -> 24 bytes);
         # shardctl's shard-addressed header has no version slot yet, so
         # the flag negotiates off there (docs/PROTOCOL.md §6.6).
-        self._stale = self.ft.stale_track and not self._sc
+        # Pipelined streaming (PROTOCOL.md §12): with FLAG_CHUNKED
+        # negotiated, GRAD/PARAM/PARAM_PUSH bodies ship as K independent
+        # chunk frames so encode, wire and apply overlap.  Rides the
+        # framed wire; off under shardctl (shard ops re-route — a chunk
+        # stream split across owners has no single admission point).
+        self._chunked = self.ft.chunked and not self._sc
+        # Staleness negotiates off under chunking: the chunked PARAM
+        # reply header carries the version in its own word (§12.3), and
+        # the 32-byte chunk header has no basis-echo slot.
+        self._stale = (self.ft.stale_track and not self._sc
+                       and not self._chunked)
         # Causal-timing telemetry (obs/clock, obs/causal): with
         # FLAG_TIMING negotiated, data frames carry a wall-µs send stamp
         # and every ack/reply a [t_tx_echo, t_recv, t_ack] tail — the
@@ -185,6 +207,16 @@ class ParamClient:
                      if self.ft.framed else 0)
         self._hdr_rx = (reply_hdr_bytes(self._stale, self._timing)
                         if self.ft.framed else 0)
+        # Chunked header sizes + the per-server chunk plan (built at
+        # start(), when the dtype is known): spans [(lo, hi)], uniform
+        # frame strides — the last chunk's frame is padded to the full
+        # stride so both sides receive into fixed-size staging (§12.2).
+        self._chdr = chunk_hdr_bytes(self._timing)
+        self._chdr_rx = chunk_reply_hdr_bytes(self._timing)
+        self._chunk_elems = 0
+        self._chunk_spans: Dict[int, list] = {}
+        self._chunk_stride: Dict[int, int] = {}
+        self._chunk_stride_rx: Dict[int, int] = {}
         self._grad_wire: Dict[int, np.ndarray] = {}
         self._param_wire: Dict[int, np.ndarray] = {}
         self._param_rx: Dict[int, np.ndarray] = {}
@@ -267,12 +299,46 @@ class ParamClient:
         flags = (FLAG_FRAMED if self.ft.framed else 0) | (
             FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0
         ) | (FLAG_STALENESS if self._stale else 0) | (
-            FLAG_TIMING if self._timing else 0)
+            FLAG_TIMING if self._timing else 0) | (
+            FLAG_CHUNKED if self._chunked else 0)
+        if self._chunked:
+            self._chunk_elems = chunk_elems_for(self.ft.chunk_bytes,
+                                                param.dtype.itemsize)
         for srank, shard in zip(self.sranks, self.shards):
             body = (self.codec.wire_nbytes(shard.size)
                     if not self.codec.identity
                     else shard.size * param.dtype.itemsize)
-            if not self.codec.identity:
+            if self._chunked:
+                # Streamed staging (§12.2): K uniform [chunk hdr | body]
+                # frames, one contiguous buffer per direction.  Encode
+                # lands each chunk behind its own header, so a retry
+                # resends any chunk's exact bytes zero-copy, and the
+                # error-feedback residual (whole-shard, sliced per
+                # chunk) folds exactly once per block.
+                spans = chunk_spans(shard.size, self._chunk_elems)
+                full = min(self._chunk_elems, shard.size)
+                cbody = (self.codec.wire_nbytes(full)
+                         if not self.codec.identity
+                         else full * param.dtype.itemsize)
+                stride = chunk_stride(self._chdr, cbody)
+                self._chunk_spans[srank] = spans
+                self._chunk_stride[srank] = stride
+                self._chunk_stride_rx[srank] = chunk_stride(self._chdr_rx,
+                                                            cbody)
+                self._grad_wire[srank] = np.zeros(stride * len(spans),
+                                                  np.uint8)
+                self._param_wire[srank] = np.zeros(stride * len(spans),
+                                                   np.uint8)
+                if self.codec.uses_residual:
+                    self._residual[srank] = np.zeros(shard.size, np.float32)
+                # One reusable reply-frame buffer: chunked PARAM replies
+                # are uniform-size messages received one at a time.
+                self._param_rx[srank] = np.zeros(self._chunk_stride_rx[srank],
+                                                 np.uint8)
+                self._ack_buf[srank] = np.zeros(
+                    CHUNK_ACK_TIMING_WORDS if self._timing
+                    else CHUNK_ACK_WORDS, np.int64)
+            elif not self.codec.identity:
                 self._grad_wire[srank] = np.zeros(self._hdr + body, np.uint8)
                 self._param_wire[srank] = np.zeros(self._hdr + body, np.uint8)
                 if self.codec.uses_residual:
@@ -282,7 +348,7 @@ class ParamClient:
                 # the header (the one staging copy framing costs).
                 self._grad_wire[srank] = np.zeros(self._hdr + body, np.uint8)
                 self._param_wire[srank] = np.zeros(self._hdr + body, np.uint8)
-            if self._hdr:
+            if self._hdr and not self._chunked:
                 # PARAM replies carry the (possibly wider) reply header —
                 # the timing tail rides there — so reads stage separately
                 # from the identically-bodied push frames.
@@ -290,7 +356,11 @@ class ParamClient:
                                                  np.uint8)
                 self._ack_buf[srank] = np.zeros(
                     ACK_TIMING_WORDS if self._timing else 2, np.int64)
-            if self.ft.active:
+            if self._chunked:
+                cinfo = init_v5(shard.offset, shard.size,
+                                self.codec.wire_id, self.ft.epoch, flags,
+                                self._chunk_elems)
+            elif self.ft.active:
                 cinfo = init_v3(shard.offset, shard.size,
                                 self.codec.wire_id, self.ft.epoch, flags)
             else:
@@ -354,6 +424,7 @@ class ParamClient:
             "epoch": self.ft.epoch,
             "framed": self.ft.framed,
             "staleness": self._stale,
+            "chunked": self._chunked,
             "basis_versions": {str(s): v for s, v in self._basis.items()},
             "map_version": getattr(self.smap, "version", None),
             "retries": self.retries,
@@ -841,6 +912,10 @@ class ParamClient:
         the per-server staging frame at ship time; the int8 residual is
         folded in and refreshed by the same pass.  Framed mode stamps
         [epoch, seq] and retries the staged bytes on deadline."""
+        if self._chunked:
+            yield from self._chunked_write(srank, shard, tags.GRAD,
+                                           tags.GRAD_ACK, "GRAD")
+            return
         span = self._spans.op("GRAD", peer=srank, side="client",
                               rank=self.rank)
         view = self.grad[shard.offset : shard.end]
@@ -876,6 +951,9 @@ class ParamClient:
         (reference pclient.lua:72-82) — via the wire staging frame when
         the codec is not identity.  Framed mode seq-tags the request and
         discards snapshot frames that echo an earlier request."""
+        if self._chunked:
+            yield from self._chunked_read(srank, shard)
+            return
         span = self._spans.op("PARAM", peer=srank, side="client",
                               rank=self.rank)
         out = self.param[shard.offset : shard.end]
@@ -962,6 +1040,10 @@ class ParamClient:
         """Whole-shard write, await ack (reference pclient.lua:60-70).
         No residual: parameter pushes (seeding / single-worker mirror)
         are one-shot state transfers, not an accumulating signal."""
+        if self._chunked:
+            yield from self._chunked_write(srank, shard, tags.PARAM_PUSH,
+                                           tags.PARAM_PUSH_ACK, "PARAM_PUSH")
+            return
         span = self._spans.op("PARAM_PUSH", peer=srank, side="client",
                               rank=self.rank)
         view = self.param[shard.offset : shard.end]
@@ -990,6 +1072,272 @@ class ParamClient:
             srank, payload, tags.PARAM_PUSH, tags.PARAM_PUSH_ACK, seq,
             f"PARAM_PUSH to server {srank}", span=span,
         )
+
+    # -- pipelined streaming transfers (FLAG_CHUNKED, PROTOCOL.md §12) -------
+
+    def _chunked_write(self, srank: int, shard: Shard, tag: int,
+                       ack_tag: int, what: str):
+        """One streamed shard write: the body ships as K independent
+        chunk frames, each encoded into its own staging slot and posted
+        *without* waiting — the transport moves chunk k while this
+        thread encodes chunk k+1 (the double-buffered encode; on the
+        event-loop TCP transport the I/O thread writes concurrently,
+        on shm the peer drains concurrently).  The server acks each
+        admitted chunk; a deadline resends only the chunks whose acks
+        never arrived, from the same staged bytes — so the int8
+        residual, folded at the single encode pass, stays exact under
+        any retry pattern."""
+        span = self._spans.op(what, peer=srank, side="client",
+                              rank=self.rank)
+        spans_ = self._chunk_spans[srank]
+        stride = self._chunk_stride[srank]
+        staging = (self._grad_wire if tag == tags.GRAD
+                   else self._param_wire)[srank]
+        view = (self.grad if tag == tags.GRAD
+                else self.param)[shard.offset: shard.end]
+        residual = (self._residual.get(srank)
+                    if tag == tags.GRAD and self.codec.uses_residual
+                    else None)
+        seq = self._next_seq(srank, tag)
+        nchunks = len(spans_)
+        span.note(epoch=self.ft.epoch, seq=seq, chunks=nchunks)
+        span.mark("encode")
+        pending: Dict[int, object] = {}
+        for k, (lo, hi) in enumerate(spans_):
+            frame = staging[k * stride: (k + 1) * stride]
+            body = frame[self._chdr: self._chdr + self._chunk_body(hi - lo)]
+            if self.codec.identity:
+                body[:] = view[lo:hi].view(np.uint8)
+            else:
+                self.codec.encode_into(
+                    view[lo:hi], body,
+                    residual=None if residual is None else residual[lo:hi])
+            pack_chunk_header(frame, self.ft.epoch, seq, k, nchunks)
+            if self._timing:
+                pack_tx_stamp(frame, self._chdr, obs_clock.wall_us())
+            span.mark("send" if k == 0 else "chunk")
+            pending[k] = self.transport.isend(frame, srank, tag)
+            # Yield between chunks: the transport pumps chunk k toward
+            # the peer (and sibling pumps get their turn) while this
+            # generator comes back to encode chunk k+1.
+            yield EXEC
+        yield from self._chunk_acks(srank, tag, ack_tag, seq, staging,
+                                    pending, span, what)
+
+    def _chunk_body(self, elems: int) -> int:
+        """Logical body bytes of a chunk covering ``elems`` elements
+        (the frame itself is padded to the uniform stride, §12.2)."""
+        if self.codec.identity:
+            return elems * self.param.dtype.itemsize
+        return self.codec.wire_nbytes(elems)
+
+    def _chunk_acks(self, srank: int, tag: int, ack_tag: int, seq: int,
+                    staging: np.ndarray, pending: Dict[int, object],
+                    span, what: str):
+        """Await one ack per chunk; on deadline, resend only the
+        missing chunks under the backoff policy.  While waiting, the
+        loop also drains send-handle completions and marks ``flush``
+        when the last chunk left this rank — the wall-clock point the
+        causal analyzer compares against the server's first apply to
+        *see* the wire/apply overlap (obs/causal.py)."""
+        buf = self._ack_buf[srank]
+        spans_ = self._chunk_spans[srank]
+        stride = self._chunk_stride[srank]
+        nchunks = len(spans_)
+        acked = [False] * nchunks
+        remaining = nchunks
+        flushed = False
+        attempt = 0
+        last: Optional[BaseException] = None
+        while self.live.io:
+            deadline = self._op_deadline()
+            try:
+                while remaining:
+                    if pending:
+                        # Drive outstanding chunk sends (transports
+                        # whose progress rides test()) and record the
+                        # moment the last chunk left this rank.  FIFO
+                        # prefix only: sends complete in post order, so
+                        # stopping at the first incomplete handle keeps
+                        # this O(1) amortized — testing every pending
+                        # handle per pass is O(K²) over a big stream.
+                        for k in list(pending):
+                            if not self.transport.test(pending[k]):
+                                break
+                            del pending[k]
+                    if not pending and not flushed:
+                        flushed = True
+                        span.mark("flush")
+                    if not self.transport.iprobe(srank, ack_tag):
+                        if not self.live.io:
+                            span.end("aborted")
+                            return None
+                        if deadline is not None \
+                                and time.monotonic() > deadline:
+                            raise DeadlineExceeded(
+                                "recv", srank, ack_tag,
+                                time.monotonic() - deadline)
+                        yield EXEC
+                        continue
+                    handle = self.transport.irecv(srank, ack_tag, out=buf)
+                    while not self.transport.test(handle):
+                        yield EXEC
+                    epoch, aseq, idx = int(buf[0]), int(buf[1]), int(buf[2])
+                    if self._timing and epoch == self.ft.epoch:
+                        self._feed_clock(srank, int(buf[3]), int(buf[4]),
+                                         int(buf[5]))
+                    if epoch == self.ft.epoch and aseq == seq:
+                        if 0 <= idx < nchunks and not acked[idx]:
+                            acked[idx] = True
+                            remaining -= 1
+                    elif epoch > self.ft.epoch or (
+                            epoch == self.ft.epoch and aseq > seq):
+                        raise RuntimeError(
+                            f"chunk ack from server {srank} is ahead of "
+                            f"the op stream: got (epoch={epoch}, "
+                            f"seq={aseq}), awaiting (epoch="
+                            f"{self.ft.epoch}, seq={seq})")
+                    # stale chunk ack (an earlier op's re-ack): drop on
+                    # the unchanged attempt deadline
+                span.mark("ack")
+                span.end("ok")
+                return True
+            except DeadlineExceeded as exc:
+                last = exc
+                attempt += 1
+                if attempt >= self._retry.attempts:
+                    span.end("exhausted")
+                    self._flight_dump("retry_exhausted", what=what,
+                                      attempts=self._retry.attempts,
+                                      peer=srank)
+                    raise RetryExhausted(what, self._retry.attempts, last)
+                backoff = self._retry.backoff_s(attempt)
+                self._m_retries.inc()
+                self._m_backoff.inc(backoff)
+                span.mark("backoff")
+                span.note(retries=attempt)
+                if not (yield from aio_sleep(backoff, live=self.live)):
+                    span.end("aborted")
+                    return None
+                # Resend ONLY the unacked chunks — identical staged
+                # bytes (re-stamped send time under FLAG_TIMING).  A
+                # still-pending stale handle is cancelled first so
+                # buffer ownership returns before the re-post; the
+                # server dedups any frame that made it through anyway.
+                span.mark("send")
+                for k in range(nchunks):
+                    if acked[k]:
+                        continue
+                    stale = pending.pop(k, None)
+                    if stale is not None and not self.transport.test(stale):
+                        self.transport.cancel(stale)
+                    frame = staging[k * stride: (k + 1) * stride]
+                    if self._timing:
+                        pack_tx_stamp(frame, self._chdr, obs_clock.wall_us())
+                    span.mark("chunk")
+                    pending[k] = self.transport.isend(frame, srank, tag)
+                    yield EXEC
+        span.end("aborted")
+        return None
+
+    def _chunked_read(self, srank: int, shard: Shard):
+        """One streamed shard read: request-by-header as usual, then
+        assemble K chunk replies — each decoded straight into its slice
+        of ``param`` on arrival, so decode overlaps the remaining
+        chunks' wire time.  Every chunk stamps its snapshot version;
+        the assembly restarts whenever a newer version appears (a
+        retried request re-served at the head), so the delivered vector
+        is always a single committed version (§12.4).  FIFO channels
+        guarantee no stale-version chunk arrives after a newer one."""
+        span = self._spans.op("PARAM", peer=srank, side="client",
+                              rank=self.rank)
+        out = self.param[shard.offset: shard.end]
+        seq = self._next_seq(srank, tags.PARAM_REQ)
+        span.note(epoch=self.ft.epoch, seq=seq,
+                  chunks=len(self._chunk_spans[srank]))
+        spans_ = self._chunk_spans[srank]
+        frame = self._param_rx[srank]
+        req = (timed_frame(self.ft.epoch, seq, 0) if self._timing
+               else header_frame(self.ft.epoch, seq))
+        last: Optional[BaseException] = None
+        for attempt in range(self._retry.attempts):
+            if attempt:
+                backoff = self._retry.backoff_s(attempt)
+                self._m_retries.inc()
+                self._m_backoff.inc(backoff)
+                span.mark("backoff")
+                span.note(retries=attempt)
+                if not (yield from aio_sleep(backoff, live=self.live)):
+                    span.end("aborted")
+                    return
+            deadline = self._op_deadline()
+            try:
+                span.mark("send")
+                if self._timing:
+                    req[2] = obs_clock.wall_us()  # re-stamped per attempt
+                yield from aio_send(self.transport, req, srank,
+                                    tags.PARAM_REQ, live=self.live,
+                                    deadline=deadline)
+                span.mark("recv")
+                seen: set = set()
+                version: Optional[int] = None
+                while True:
+                    while not self.transport.iprobe(srank, tags.PARAM):
+                        if not self.live.io:
+                            span.end("aborted")
+                            return
+                        if deadline is not None \
+                                and time.monotonic() > deadline:
+                            raise DeadlineExceeded(
+                                "recv", srank, tags.PARAM,
+                                time.monotonic() - deadline)
+                        yield EXEC
+                    handle = self.transport.irecv(srank, tags.PARAM,
+                                                  out=frame)
+                    while not self.transport.test(handle):
+                        yield EXEC
+                    epoch, aseq, idx, cnt, ver = unpack_chunk_reply(frame)
+                    if self._timing and epoch == self.ft.epoch:
+                        t_tx, t_recv, t_ack = unpack_reply_stamps(
+                            frame, self._chdr_rx - 24)
+                        self._feed_clock(srank, t_tx, t_recv, t_ack)
+                    if epoch > self.ft.epoch or (
+                            epoch == self.ft.epoch and aseq > seq):
+                        raise RuntimeError(
+                            f"chunked PARAM reply from server {srank} is "
+                            f"ahead of the op stream: got (epoch={epoch}, "
+                            f"seq={aseq}), awaiting (epoch={self.ft.epoch},"
+                            f" seq={seq})")
+                    if epoch != self.ft.epoch or aseq != seq \
+                            or not (0 <= idx < len(spans_)):
+                        continue  # stale reply chunk: drop
+                    if version is None or ver > version:
+                        version, seen = ver, set()
+                    elif ver < version:
+                        continue  # an earlier serve's straggler: drop
+                    if idx in seen:
+                        continue  # duplicated chunk: already decoded
+                    seen.add(idx)
+                    lo, hi = spans_[idx]
+                    span.mark("decode")
+                    body = frame[self._chdr_rx:
+                                 self._chdr_rx + self._chunk_body(hi - lo)]
+                    if self.codec.identity:
+                        out[lo:hi].view(np.uint8)[:] = body
+                    else:
+                        self.codec.decode_into(body, out[lo:hi])
+                    if len(seen) == cnt:
+                        span.end("ok")
+                        return
+            except DeadlineExceeded as exc:
+                last = exc
+        span.end("exhausted")
+        self._flight_dump("retry_exhausted",
+                          what=f"chunked PARAM read from server {srank}",
+                          attempts=self._retry.attempts, peer=srank)
+        raise RetryExhausted(
+            f"chunked PARAM read from server {srank}",
+            self._retry.attempts, last)
 
     def _encode(self, view: np.ndarray, wire: Optional[np.ndarray],
                 residual: Optional[np.ndarray] = None) -> np.ndarray:

@@ -81,7 +81,10 @@ from mpit_tpu.comm.transport import Transport
 from mpit_tpu.cells import wire as _cellwire
 from mpit_tpu.ft import (
     ACK_TIMING_WORDS,
+    CHUNK_ACK_TIMING_WORDS,
+    CHUNK_ACK_WORDS,
     DUP,
+    FLAG_CHUNKED,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
     FLAG_READONLY,
@@ -94,10 +97,16 @@ from mpit_tpu.ft import (
     DedupTable,
     FTConfig,
     LeaseRegistry,
+    chunk_hdr_bytes,
+    chunk_reply_hdr_bytes,
+    chunk_spans,
+    chunk_stride,
     hdr_bytes,
+    pack_chunk_reply,
     pack_reply_stamps,
     pack_version,
     reply_hdr_bytes,
+    unpack_chunk_header,
     unpack_header,
     unpack_tx_stamp,
     unpack_version,
@@ -259,6 +268,17 @@ class ParamServer:
         # estimator consumes, and their heartbeats are echoed back on
         # HEARTBEAT_ECHO so the estimate refreshes between ops.
         self._timing: Dict[int, bool] = {}
+        # Pipelined streaming posture (FLAG_CHUNKED, §12): elements per
+        # chunk announced in INIT v5 (0/absent = whole-frame transfers),
+        # the per-client fixed-size chunk receive staging (separate
+        # buffers for the concurrent GRAD and PARAM_PUSH services), the
+        # PARAM_PUSH assembly frames, and the per-(codec, chunk-size)
+        # jitted chunk-apply cache.
+        self._chunk: Dict[int, int] = {}
+        self._chunk_rx: Dict[int, np.ndarray] = {}
+        self._chunk_rx_push: Dict[int, np.ndarray] = {}
+        self._chunk_asm: Dict[int, np.ndarray] = {}
+        self._chunk_apply_cache: Dict[Tuple[str, int], Callable] = {}
         _members = self.cranks + self.readers + self.cells
         self._gen: Dict[int, int] = {c: 0 for c in _members}
         self._svc_live: Dict[int, int] = {c: 0 for c in _members}
@@ -433,6 +453,7 @@ class ParamServer:
                     "framed": self._framed.get(c, False),
                     "stale": self._stale_track.get(c, False),
                     "timing": self._timing.get(c, False),
+                    "chunk": self._chunk.get(c, 0),
                     "codec": getattr(self._codecs.get(c), "name", None),
                 }
                 for c in self.cranks
@@ -523,18 +544,29 @@ class ParamServer:
                 f"client {crank} announced a legacy INIT on a shardctl "
                 "server — a gang is shardctl everywhere or nowhere"
             )
+        chunk_elems = 0
         if raw.size == 2:  # legacy 16-byte v1 announcement
             offset, size, wire_id = int(raw[0]), int(raw[1]), 0
         elif raw.size == 3:
             offset, size, wire_id = (int(x) for x in raw)
         elif raw.size == 5:  # INIT v3: [offset, size, codec_id, epoch, flags]
             offset, size, wire_id, epoch, flags = (int(x) for x in raw)
+        elif raw.size == 6:  # INIT v5: v3 + [chunk_elems] (FLAG_CHUNKED)
+            offset, size, wire_id, epoch, flags, chunk_elems = (
+                int(x) for x in raw)
         else:
             raise ValueError(
                 f"client {crank} INIT announcement is {len(payload)} bytes; "
                 "expected 16 (legacy [offset, size]), 24 "
-                "([offset, size, codec_id]) or 40 (v3 + [epoch, flags])"
+                "([offset, size, codec_id]), 40 (v3 + [epoch, flags]) or "
+                "48 (v5 + [chunk_elems])"
             )
+        chunked = bool(flags & FLAG_CHUNKED)
+        if chunked != (raw.size == 6):
+            raise ValueError(
+                f"client {crank} INIT is malformed: FLAG_CHUNKED and the "
+                "48-byte v5 announcement (which carries the chunk cut) "
+                "must travel together (docs/PROTOCOL.md §12.1)")
         # READ-ONLY attach (serving tier, §8): the posture is a property
         # of the *rank role*, so a reader announcing as a writer (or
         # vice versa) is a misconfiguration, caught here loudly.  The
@@ -605,18 +637,69 @@ class ParamServer:
             )
         self._framed[crank] = bool(flags & FLAG_FRAMED)
         self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
+        # Pipelined streaming (§12): a writer-role, framed-only posture.
+        if chunked:
+            if ro or sub:
+                raise ValueError(
+                    f"rank {crank} announced FLAG_CHUNKED with a "
+                    "READONLY/SUBSCRIBE posture — reads are served by "
+                    "the §8 dispatcher and cells by the diff stream; "
+                    "chunked streaming is the writer path (§12.1)")
+            if not self._framed[crank]:
+                raise ValueError(
+                    f"client {crank} announced FLAG_CHUNKED without "
+                    "FLAG_FRAMED — chunk retry/dedup rides the framed "
+                    "identity (§12.1)")
+            if chunk_elems <= 0 or chunk_elems % codec_mod.BLOCK:
+                raise ValueError(
+                    f"client {crank} announced chunk_elems={chunk_elems}; "
+                    f"must be a positive multiple of {codec_mod.BLOCK} "
+                    "(the codec block boundary, §12.2)")
+            self._require_splittable_rule(crank)
+        self._chunk[crank] = chunk_elems if chunked else 0
         # Staleness telemetry only rides the framed wire: the version
         # word extends the [epoch, seq] header, so a FLAG_STALENESS
         # without FLAG_FRAMED negotiates off (nothing to extend).
         # Readers negotiate both extensions off: their replies use the
         # §8 status header, which carries the version in its own word.
+        # Chunked pairs negotiate it off too — the chunked PARAM reply
+        # header carries the version in its own word (§12.3).
         self._stale_track[crank] = (self._framed[crank] and not ro
+                                    and not chunked
                                     and bool(flags & FLAG_STALENESS))
         # Same rule for the timing extension: no frame, no stamp slot.
         self._timing[crank] = (self._framed[crank] and not ro
                                and bool(flags & FLAG_TIMING))
         self.leases.arm(crank, epoch, heartbeats=self._hb[crank])
         return codec
+
+    def _require_splittable_rule(self, crank: int) -> None:
+        """Chunked streaming applies chunk *k* before chunk *k+1* has
+        arrived, which is only bitwise-equal to the whole-shard apply
+        when the rule is element-wise over (param, grad, state) — i.e.
+        every state leaf is param-shaped (or the state is empty).  A
+        scalar leaf (Adam's step counter ``t``) would advance once per
+        chunk instead of once per op; refuse loudly at negotiation
+        rather than corrupt the math quietly (§12.5)."""
+        state = (self._hbm.rule_state if self._hbm is not None
+                 else self.rule_state)
+        bad = sorted(k for k, v in (state or {}).items()
+                     if tuple(np.shape(v)) != (self.size,))
+        if bad:
+            raise ValueError(
+                f"client {crank} announced FLAG_CHUNKED but this "
+                f"server's rule carries non-element-wise state leaves "
+                f"{bad} (e.g. a scalar step counter) — per-chunk apply "
+                "would not be bitwise-equal to the whole-shard apply. "
+                "Use a splittable rule (add/rmsprop/adadelta) or turn "
+                "chunking off (docs/PROTOCOL.md §12.5)")
+        if self._hbm is None and self.rule_state:
+            # The chunk applies DONATE param + state (in-place slice
+            # updates; §12.3), and rule inits may alias several leaves
+            # to one zeros buffer (rmsprop) — donating one buffer
+            # twice is an XLA error.  Break the aliasing now (the
+            # dplane slot does the same at construction).
+            self.rule_state = _dphbm.dedupe_state(self.rule_state)
 
     def _negotiate_v4(self, crank: int, raw: np.ndarray) -> "codec_mod.Codec":
         """INIT v4: codec + FT posture + the versioned shard map.  The
@@ -693,11 +776,14 @@ class ParamServer:
         """Place one flat param vector on this server's backend: the
         dplane placement (mesh-sharded HBM) when configured, else the
         legacy device context.  Rule state built from the result
-        inherits the placement (zeros_like preserves sharding)."""
+        inherits the placement (zeros_like preserves sharding).
+        Always re-owned on device (dplane.hbm.device_copy): slot
+        params feed donated applies under dplane, and a numpy-aliased
+        buffer there is a use-after-free."""
         if self._dp_cfg is not None:
-            return _dphbm.place_flat(arr, self._dp_cfg)
+            return _dphbm.device_copy(_dphbm.place_flat(arr, self._dp_cfg))
         with self._dev_ctx():
-            return jnp.asarray(arr)
+            return _dphbm.device_copy(jnp.asarray(arr))
 
     def _place_state(self, state):
         """Place a restored rule-state dict next to its param."""
@@ -763,6 +849,27 @@ class ParamServer:
             if self._hb.get(crank):
                 self._hb_buf[crank] = np.zeros(2, np.int64)
             return
+        if self._chunk.get(crank):
+            # Streamed pairs receive fixed-size chunk frames into
+            # per-service staging (GRAD and PARAM_PUSH run concurrently
+            # — one buffer each); assembly/serve staging is lazy.
+            timing = self._timing.get(crank, False)
+            stride = self._chunk_stride_for(crank, codec)
+            self._codecs[crank] = codec
+            for store in (self._grad_views, self._grad_data,
+                          self.grad_bufs, self._push_bufs,
+                          self._push_host, self._param_send,
+                          self._chunk_asm):
+                store.pop(crank, None)
+            self._chunk_rx[crank] = np.zeros(stride, np.uint8)
+            self._chunk_rx_push[crank] = np.zeros(stride, np.uint8)
+            self._ack_send[crank] = np.zeros(
+                CHUNK_ACK_TIMING_WORDS if timing else CHUNK_ACK_WORDS,
+                np.int64)
+            self._req_buf[crank] = np.zeros(3 if timing else 2, np.int64)
+            if self._hb.get(crank):
+                self._hb_buf[crank] = np.zeros(3 if timing else 2, np.int64)
+            return
         hdr = self._hdr_for(crank)
         self._codecs[crank] = codec
         self._grad_views.pop(crank, None)
@@ -770,6 +877,9 @@ class ParamServer:
         self._push_bufs.pop(crank, None)
         self._push_host.pop(crank, None)
         self._param_send.pop(crank, None)
+        self._chunk_rx.pop(crank, None)
+        self._chunk_rx_push.pop(crank, None)
+        self._chunk_asm.pop(crank, None)
         if codec.identity:
             buf = np.zeros(hdr + self.size * np.dtype(self.dtype).itemsize,
                            np.uint8)
@@ -793,7 +903,8 @@ class ParamServer:
         for store in (self.grad_bufs, self._grad_views, self._grad_data,
                       self._push_bufs, self._push_host, self._param_send,
                       self._codecs, self._ack_send, self._req_buf,
-                      self._hb_buf):
+                      self._hb_buf, self._chunk_rx, self._chunk_rx_push,
+                      self._chunk_asm):
             store.pop(crank, None)
 
     def _apply_for(self, codec: "codec_mod.Codec") -> Callable:
@@ -886,6 +997,16 @@ class ParamServer:
             # all draw from the same single copy.
             host = (self._hbm.snapshot_host() if self._hbm is not None
                     else np.asarray(self.param))
+            if self._hbm is None and not host.flags.owndata \
+                    and any(self._chunk.values()):
+                # Chunked clients (§12): their donated per-chunk
+                # applies update the param in place, which jax rightly
+                # declines while a zero-copy snapshot view pins the
+                # buffer — and a declined donation re-copies the WHOLE
+                # shard on the next chunk.  Materialize the snapshot
+                # instead: one extra sweep per committed version buys
+                # in-place applies for every chunk after it.
+                host = np.array(host)
             self._snap_host = (version, host)
             self._m_snap_copies.inc()
         host = self._snap_host[1]
@@ -927,6 +1048,371 @@ class ParamServer:
             buf[2], buf[3], buf[4] = t_tx, t_recv, obs_clock.wall_us()
         yield from aio_send(self.transport, buf, crank, tag, live=self.live,
                             abort=self._svc_abort(crank, gen))
+
+    # -- pipelined streaming services (FLAG_CHUNKED, PROTOCOL.md §12) --------
+
+    def _chunk_body_for(self, codec: "codec_mod.Codec", elems: int) -> int:
+        """Logical body bytes of a chunk covering ``elems`` elements."""
+        if codec.identity:
+            return elems * np.dtype(self.dtype).itemsize
+        return codec.wire_nbytes(elems)
+
+    def _chunk_stride_for(self, crank: int,
+                          codec: "Optional[codec_mod.Codec]" = None) -> int:
+        """The uniform chunk data-frame size for one client (§12.2)."""
+        codec = codec if codec is not None else self._codecs[crank]
+        full = min(self._chunk[crank], self.size)
+        return chunk_stride(chunk_hdr_bytes(self._timing.get(crank, False)),
+                            self._chunk_body_for(codec, full))
+
+    def _send_chunk_ack(self, crank: int, tag: int, epoch: int, seq: int,
+                        idx: int, gen: int, t_tx: int = 0, t_recv: int = 0):
+        """One per-chunk ack: [epoch, seq, chunk_idx] (+ the timing
+        tail) — the unit the client's resend-missing-chunks loop keys
+        on."""
+        buf = self._ack_send[crank]
+        buf[0], buf[1], buf[2] = epoch, seq, idx
+        if self._timing.get(crank):
+            buf[3], buf[4], buf[5] = t_tx, t_recv, obs_clock.wall_us()
+        yield from aio_send(self.transport, buf, crank, tag, live=self.live,
+                            abort=self._svc_abort(crank, gen))
+
+    def _chunk_apply_for(self, codec: "Optional[codec_mod.Codec]",
+                         csize: int) -> Callable:
+        """The jitted per-chunk decode+apply for the host-resident
+        shard — element-wise slice math, one XLA call per chunk,
+        cached per (codec, chunk size) with ``lo`` traced.  Param and
+        state are DONATED: XLA then updates the slice in place (38x
+        measured over the reallocating program at 64 MB/16 chunks —
+        without donation every chunk apply copies the WHOLE shard, so
+        a K-chunk op costs O(K·size) instead of O(size)).  Donation
+        on the host backend is best-effort and numerics-neutral: jax
+        declines it while a snapshot view pins the buffer, which is
+        exactly the safety the version-keyed snapshot cache needs."""
+        key = (codec.name if codec is not None else None, csize)
+        fn = self._chunk_apply_cache.get(key)
+        if fn is None:
+            rule_apply = self.rule.apply
+
+            def _chunk_apply(param, payload, state, lo):
+                g = (payload if codec is None or codec.identity
+                     else codec.decode_parts(payload, csize))
+                psl = jax.lax.dynamic_slice(param, (lo,), (csize,))
+                ssl = {k: jax.lax.dynamic_slice(v, (lo,), (csize,))
+                       for k, v in state.items()}
+                pn, sn = rule_apply(psl, g, ssl)
+                return (jax.lax.dynamic_update_slice(param, pn, (lo,)),
+                        {k: jax.lax.dynamic_update_slice(state[k], sn[k],
+                                                         (lo,))
+                         for k in state})
+
+            fn = jax.jit(_chunk_apply, donate_argnums=(0, 2))
+            self._chunk_apply_cache[key] = fn
+        return fn
+
+    def _chunk_fused_ok(self) -> bool:
+        """Whether the per-chunk apply may fuse the codec decode into
+        the same XLA call as the rule (§12.5).  XLA contracts a decode
+        multiply feeding the apply into an fma — a single rounding —
+        but only when the decode is one piece; the whole-shard program
+        concatenates (and double-rounds) whenever the shard has a
+        partial trailing block.  Bitwise equality to the unchunked
+        apply therefore requires matching its rounding: fuse when the
+        full-shard decode is concat-free, otherwise decode the chunk
+        host-side (bit-identical to the host oracle) and apply the
+        materialized f32 — exactly the two-rounding sequence the
+        concatenated program produces."""
+        return self.size % codec_mod.BLOCK == 0 \
+            or self.size <= codec_mod.BLOCK
+
+    def _chunk_decoded(self, crank: int, codec: "codec_mod.Codec",
+                       body: np.ndarray, csize: int) -> np.ndarray:
+        """Host-decode one chunk into a FRESH f32 buffer (the non-fused
+        rounding path of :meth:`_chunk_fused_ok`).  Fresh per chunk on
+        purpose — see :meth:`_chunk_owned`: jax aliases aligned host
+        arrays, so a reused scratch would race the async apply."""
+        out = np.empty(csize, np.float32)
+        codec.decode_into(body, out)
+        return out
+
+    @staticmethod
+    def _chunk_owned(view: np.ndarray) -> np.ndarray:
+        """An *owned* copy of a chunk-receive view for handing to jax.
+        Chunk frames arrive back-to-back into one reused staging buffer
+        — unlike whole-frame ops, there is no ack round trip between a
+        chunk's dispatch and the next chunk's receive, so jax's own
+        (asynchronous) host transfer can still be reading the staging
+        when the next chunk lands.  Copying synchronously here and
+        letting jax zero-copy-alias the owned result costs the same
+        one sweep the internal transfer would have, with no race."""
+        return np.array(view)
+
+    def _apply_chunk(self, crank: int, codec: "codec_mod.Codec",
+                     body: np.ndarray, lo: int, hi: int,
+                     commit: bool) -> None:
+        """Decode+apply one GRAD chunk — fused into one XLA call when
+        that matches the unchunked rounding (:meth:`_chunk_fused_ok`);
+        the version commits once per op (on the final chunk), so the
+        snapshot cache and diff stream keep op-granular versions."""
+        csize = hi - lo
+        fused = codec.identity or self._chunk_fused_ok()
+        if self._hbm is not None:
+            if codec.identity:
+                payload: Any = self._chunk_owned(body.view(self.dtype))
+                self._hbm.apply_wire_chunk(codec, payload, lo, csize,
+                                           commit=commit)
+            elif fused:
+                self._hbm.apply_wire_chunk(
+                    codec,
+                    [self._chunk_owned(v)
+                     for v in codec.split_wire(body, csize)],
+                    lo, csize, commit=commit)
+            else:
+                self._hbm.apply_wire_chunk(
+                    None, self._chunk_decoded(crank, codec, body, csize),
+                    lo, csize, commit=commit)
+            self.param = self._hbm.param
+            self.rule_state = self._hbm.rule_state
+            return
+        with self._dev_ctx():
+            if codec.identity:
+                grad_in: Any = jnp.asarray(
+                    self._chunk_owned(body.view(self.dtype)))
+                apply_fn = self._chunk_apply_for(codec, csize)
+            elif fused:
+                grad_in = [jnp.asarray(self._chunk_owned(v))
+                           for v in codec.split_wire(body, csize)]
+                apply_fn = self._chunk_apply_for(codec, csize)
+            else:
+                grad_in = jnp.asarray(
+                    self._chunk_decoded(crank, codec, body, csize))
+                apply_fn = self._chunk_apply_for(None, csize)
+            self.param, self.rule_state = apply_fn(
+                self.param, grad_in, self.rule_state, np.int32(lo))
+
+    def _recv_grad_chunked(self, crank: int, gen: int = 0):
+        """The streamed GRAD service: each chunk frame is admitted per
+        (op, chunk), applied the moment it lands — while later chunks
+        are still on the wire — and acked individually.  The op commits
+        (version bump, counters) on the admission that completed it;
+        duplicate chunks re-ack without a second apply, so the client's
+        encode-once staging keeps int8 error feedback exact under any
+        retry pattern."""
+        codec = self._codecs.get(crank)
+        if codec is None:
+            return
+        timing = self._timing.get(crank, False)
+        chdr = chunk_hdr_bytes(timing)
+        rxbuf = self._chunk_rx[crank]
+        spans_ = chunk_spans(self.size, self._chunk[crank])
+        cur: "Optional[Tuple[int, int]]" = None
+        span = None
+        while self.live.on:
+            got = yield from aio_recv(
+                self.transport, crank, tags.GRAD, live=self.live,
+                out=rxbuf, abort=self._svc_abort(crank, gen),
+            )
+            if got is None:
+                if span is not None:
+                    span.end("aborted")
+                return
+            epoch, seq, idx, cnt = unpack_chunk_header(rxbuf)
+            t_tx = t_recv = 0
+            if timing:
+                t_recv = obs_clock.wall_us()
+                t_tx = unpack_tx_stamp(rxbuf, chdr)
+            self.leases.renew(crank, epoch)
+            if not (0 <= idx < len(spans_)) or cnt != len(spans_):
+                raise ValueError(
+                    f"chunked GRAD from client {crank} addresses chunk "
+                    f"{idx}/{cnt} but this shard cuts into "
+                    f"{len(spans_)} chunks — chunk layouts diverged "
+                    "(INIT v5 carries the cut; §12.2)")
+            verdict, done = self.dedup.admit_chunk(
+                crank, tags.GRAD, epoch, seq, idx, cnt)
+            if verdict == STALE:
+                self._m_stale.inc()
+                continue
+            if verdict == DUP:
+                self._m_dups.inc()
+                yield from self._send_chunk_ack(
+                    crank, tags.GRAD_ACK, epoch, seq, idx, gen,
+                    t_tx=t_tx, t_recv=t_recv)
+                continue
+            if cur != (epoch, seq):
+                if span is not None:
+                    # The client abandoned an op mid-stream (teardown
+                    # races only — the pump never overlaps ops).
+                    span.end("aborted")
+                cur = (epoch, seq)
+                span = self._spans.op("GRAD", peer=crank, side="server",
+                                      rank=self.rank)
+                span.note(epoch=epoch, seq=seq, chunks=cnt)
+            lo, hi = spans_[idx]
+            span.mark("apply")
+            body = rxbuf[chdr: chdr + self._chunk_body_for(codec, hi - lo)]
+            self._apply_chunk(crank, codec, body, lo, hi, commit=done)
+            if done:
+                self._m_grads.inc()
+                self._committed()
+            if not self.live.on:
+                span.end("aborted")
+                span, cur = None, None
+                continue
+            span.mark("ack")
+            yield from self._send_chunk_ack(
+                crank, tags.GRAD_ACK, epoch, seq, idx, gen,
+                t_tx=t_tx, t_recv=t_recv)
+            if done:
+                span.end("applied")
+                span, cur = None, None
+
+    def _serve_param_chunks(self, crank: int, codec: "codec_mod.Codec",
+                            epoch: int, seq: int, req, t_recv: int,
+                            gen: int, span):
+        """Answer one chunked PARAM read: cut the shared snapshot
+        cache's full frame into K independent chunk frames — every one
+        stamped with the snapshot version — and post each without
+        waiting, so the gather of chunk k+1 overlaps the wire time of
+        chunk k.  The staging is per-client; the sends are awaited
+        before returning so the next request cannot rewrite frames
+        still in flight."""
+        timing = self._timing.get(crank, False)
+        chdr = chunk_reply_hdr_bytes(timing)
+        spans_ = chunk_spans(self.size, self._chunk[crank])
+        full = min(self._chunk[crank], self.size)
+        stride = chunk_stride(chdr, self._chunk_body_for(codec, full))
+        span.mark("snapshot")
+        wire = self._snapshot_wire(codec)
+        wire_u8 = wire.view(np.uint8) if wire.dtype != np.uint8 else wire
+        version = self._snap_version
+        staging = self._param_send.get(crank)
+        if staging is None or len(staging) != stride * len(spans_):
+            staging = np.zeros(stride * len(spans_), np.uint8)
+            self._param_send[crank] = staging
+        itemsize = np.dtype(self.dtype).itemsize
+        handles = []
+        span.mark("send")
+        for k, (lo, hi) in enumerate(spans_):
+            frame = staging[k * stride: (k + 1) * stride]
+            pack_chunk_reply(frame, epoch, seq, k, len(spans_), version)
+            if timing:
+                pack_reply_stamps(frame, chdr - TIMING_TAIL_BYTES,
+                                  int(req[2]), t_recv, obs_clock.wall_us())
+            codec_mod.gather_chunk(codec, wire_u8, self.size, lo, hi,
+                                   frame[chdr:], itemsize=itemsize)
+            if k:
+                span.mark("chunk")
+            handles.append(self.transport.isend(frame, crank, tags.PARAM))
+            yield EXEC
+        for handle in handles:
+            while not self.transport.test(handle):
+                if not self.live.io or self._svc_abort(crank, gen)():
+                    self.transport.cancel(handle)
+                    span.end("aborted")
+                    return
+                yield EXEC
+        self._m_served.inc()
+        span.end("served")
+
+    def _recv_param_chunked(self, crank: int, once: bool = True,
+                            warn_unexpected: bool = False, gen: int = 0):
+        """The streamed PARAM_PUSH service: chunk frames scatter into a
+        full-frame assembly buffer and the shard seeds exactly once,
+        when the last chunk lands.  Chunks ack on admission (like GRAD
+        — a commit-only ack would deadlock against periodic drop plans,
+        which hit the same chunk index on every full resend), but the
+        admissions are NOT checkpoint-persisted: the assembly bytes die
+        with the process, so a server restarted mid-push answers the
+        retried remainder with a fresh partial that can never complete
+        and the push fails loudly (RetryExhausted) instead of seeding a
+        torn vector (§12.6)."""
+        codec = self._codecs.get(crank)
+        if codec is None:
+            return
+        timing = self._timing.get(crank, False)
+        chdr = chunk_hdr_bytes(timing)
+        rxbuf = self._chunk_rx_push[crank]
+        spans_ = chunk_spans(self.size, self._chunk[crank])
+        itemsize = np.dtype(self.dtype).itemsize
+        while self.live.on:
+            got = yield from aio_recv(
+                self.transport, crank, tags.PARAM_PUSH, live=self.live,
+                out=rxbuf, abort=self._svc_abort(crank, gen),
+            )
+            if got is None:
+                return
+            epoch, seq, idx, cnt = unpack_chunk_header(rxbuf)
+            t_tx = t_recv = 0
+            if timing:
+                t_recv = obs_clock.wall_us()
+                t_tx = unpack_tx_stamp(rxbuf, chdr)
+            self.leases.renew(crank, epoch)
+            if not (0 <= idx < len(spans_)) or cnt != len(spans_):
+                raise ValueError(
+                    f"chunked PARAM_PUSH from client {crank} addresses "
+                    f"chunk {idx}/{cnt} but this shard cuts into "
+                    f"{len(spans_)} chunks (§12.2)")
+            verdict, done = self.dedup.admit_chunk(
+                crank, tags.PARAM_PUSH, epoch, seq, idx, cnt)
+            if verdict == STALE:
+                self._m_stale.inc()
+                continue
+            if verdict == DUP:
+                self._m_dups.inc()
+                yield from self._send_chunk_ack(
+                    crank, tags.PARAM_PUSH_ACK, epoch, seq, idx, gen,
+                    t_tx=t_tx, t_recv=t_recv)
+                continue
+            asm = self._chunk_asm.get(crank)
+            need = self._chunk_body_for(codec, self.size)
+            if asm is None or len(asm) != need:
+                asm = np.zeros(need, np.uint8)
+                self._chunk_asm[crank] = asm
+            lo, hi = spans_[idx]
+            body = rxbuf[chdr: chdr + self._chunk_body_for(codec, hi - lo)]
+            codec_mod.scatter_chunk(codec, asm, self.size, lo, hi, body,
+                                    itemsize=itemsize)
+            if not done:
+                yield from self._send_chunk_ack(
+                    crank, tags.PARAM_PUSH_ACK, epoch, seq, idx, gen,
+                    t_tx=t_tx, t_recv=t_recv)
+                continue
+            span = self._spans.op("PARAM_PUSH", peer=crank, side="server",
+                                  rank=self.rank)
+            span.note(epoch=epoch, seq=seq, chunks=cnt)
+            if warn_unexpected:
+                self.log.warning(
+                    "client %d seeded a RESTORED server: checkpointed "
+                    "params overwritten (optimizer state kept) — start "
+                    "resume clients with seed_servers=False", crank,
+                )
+            span.mark("apply")
+            if codec.identity:
+                # Owned copy: the assembly buffer is reused by the next
+                # push while jax may still alias this seed's bytes
+                # (see _chunk_owned).
+                host: Any = self._chunk_owned(asm.view(self.dtype))
+            else:
+                host = np.empty(self.size, np.float32)
+                codec.decode_into(asm, host)
+            if self._hbm is not None:
+                self._hbm.seed(host)
+                self.param = self._hbm.param
+            else:
+                with self._dev_ctx():
+                    # device_copy: a numpy-aliased param entering the
+                    # donated chunk applies would hand XLA memory it
+                    # does not own (dplane.hbm.device_copy docstring).
+                    self.param = _dphbm.device_copy(jnp.asarray(host))
+            self._committed()
+            span.mark("ack")
+            yield from self._send_chunk_ack(
+                crank, tags.PARAM_PUSH_ACK, epoch, seq, idx, gen,
+                t_tx=t_tx, t_recv=t_recv)
+            span.end("applied")
+            if once:
+                return
 
     # -- service generators (reference pserver.lua coroutines) --------------
 
@@ -977,6 +1463,10 @@ class ParamServer:
         BiCNN recvparam_always service, BiCNN/pserver.lua:220-232).
         Framed pushes are dedup-admitted: a retried seed is applied once
         and re-acked."""
+        if self._chunk.get(crank):
+            yield from self._recv_param_chunked(
+                crank, once=once, warn_unexpected=warn_unexpected, gen=gen)
+            return
         codec = self._codecs.get(crank)
         if codec is None:  # init never completed (stopped before announce)
             return
@@ -1033,7 +1523,11 @@ class ParamServer:
                 self.param = self._hbm.param
             else:
                 with self._dev_ctx():
-                    self.param = jnp.asarray(host)
+                    # device_copy: a chunked sibling client's donated
+                    # chunk applies may consume this param — it must
+                    # be device-owned, not a staging alias (cold path;
+                    # dplane.hbm.device_copy).
+                    self.param = _dphbm.device_copy(jnp.asarray(host))
             self._committed()
             span.mark("ack")
             if framed:
@@ -1091,6 +1585,12 @@ class ParamServer:
                 span.end("stale")
                 continue
             self.leases.renew(crank, epoch)
+            if self._chunk.get(crank):
+                span.note(chunks=len(chunk_spans(self.size,
+                                                 self._chunk[crank])))
+                yield from self._serve_param_chunks(
+                    crank, codec, epoch, seq, req, t_recv, gen, span)
+                continue
             span.mark("snapshot")
             hdr = self._reply_hdr_for(crank)
             wire = self._snapshot_wire(codec)
@@ -1527,6 +2027,9 @@ class ParamServer:
         Framed frames are dedup-admitted on (epoch, seq): duplicates are
         re-acked without a second apply — with the client's encode-once
         staging this is what keeps error feedback exact under retries."""
+        if self._chunk.get(crank):
+            yield from self._recv_grad_chunked(crank, gen=gen)
+            return
         codec = self._codecs.get(crank)
         if codec is None:  # init never completed (stopped before announce)
             return
@@ -2291,6 +2794,7 @@ class ParamServer:
                 "hb": self._hb.get(c, False),
                 "stale": self._stale_track.get(c, False),
                 "timing": self._timing.get(c, False),
+                "chunk": self._chunk.get(c, 0),
                 "epoch": self.leases.epoch(c),
             }
             for c in self._codecs
@@ -2341,6 +2845,13 @@ class ParamServer:
                 "grads_applied": self.grads_applied,
                 "snap_version": self._snap_version,
                 "dedup": self.dedup.state(),
+                # In-flight chunk admissions for the GRAD immediate-
+                # apply path ONLY: those chunks are already folded into
+                # the param bytes above, so set + state cut together.
+                # PARAM_PUSH partials stay out — their assembly staging
+                # dies with the process (ft/dedup.py partial_state).
+                "dedup_chunks": self.dedup.partial_state(
+                    tags={tags.GRAD}),
                 "clients": self._client_meta(),
             },
         ))
@@ -2363,6 +2874,7 @@ class ParamServer:
         self.grads_applied = int(meta.get("grads_applied", 0))
         self._snap_version = int(meta.get("snap_version", 0))
         self.dedup.restore(meta.get("dedup", {}))
+        self.dedup.restore_partial(meta.get("dedup_chunks", {}))
         if self._dp_cfg is not None:
             self._hbm = _dphbm.HbmSlot(size, self.rule, self.dtype,
                                        config=self._dp_cfg, rank=self.rank)
@@ -2377,10 +2889,16 @@ class ParamServer:
             self.rule_state = self._hbm.rule_state
         else:
             with self._dev_ctx():
-                self.param = jnp.asarray(param)
+                # device_copy on the restore path: checkpointed arrays
+                # are numpy-backed, and a restored chunked client's
+                # donated applies must never consume numpy-owned
+                # memory (dplane.hbm.device_copy).  Cold path — one
+                # extra copy per restore.
+                self.param = _dphbm.device_copy(jnp.asarray(param))
                 if state:
-                    self.rule_state = {k: jnp.asarray(v)
-                                       for k, v in state.items()}
+                    self.rule_state = {
+                        k: _dphbm.device_copy(jnp.asarray(v))
+                        for k, v in state.items()}
                 else:  # stateless rule (plain add) or legacy checkpoint
                     self.rule_state = self.rule.init(self.param)
         for crank_s, info in (meta.get("clients") or {}).items():
@@ -2391,6 +2909,7 @@ class ParamServer:
             self._hb[crank] = bool(info.get("hb", False))
             self._stale_track[crank] = bool(info.get("stale", False))
             self._timing[crank] = bool(info.get("timing", False))
+            self._chunk[crank] = int(info.get("chunk", 0))
             self.leases.arm(crank, int(info.get("epoch", 0)),
                             heartbeats=self._hb[crank])
             self._alloc_client(crank, codec_mod.get(info.get("codec", "none")))

@@ -87,6 +87,12 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     # offset estimator so `python -m mpit_tpu.obs analyze` can join and
     # decompose the gang's trace.  Needs ft_op_deadline_s > 0.
     ft_timing=False,
+    # Pipelined streaming transfers (docs/PROTOCOL.md §12): GRAD /
+    # PARAM / PARAM_PUSH bodies ship as ~this-many-byte chunk frames so
+    # encode, wire and apply overlap on big shards.  Needs
+    # ft_op_deadline_s > 0 (chunk retry/dedup ride the framed
+    # machinery) and an element-wise server rule; off under shardctl.
+    ft_chunk_bytes=0,
     supervise=0,
     # shardctl (mpit_tpu.shardctl): the LAST rank becomes the shard-map
     # controller (the rest split into servers/clients as usual), clients
@@ -126,6 +132,16 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     # shard needs a replica).
     cells=0,
     cell_max_lag=4,
+    # Cell subscription codec (ROADMAP item 3): the diff stream's XOR
+    # deltas ride the *encoded* domain, so an int8 subscription is ~4x
+    # cheaper per hop than fp32 — and bit-exact by the same induction
+    # (the cell installs the upstream's encoded frame byte-for-byte;
+    # readers decode exactly what a direct int8 read would).  Empty =
+    # default the fleet to int8; --cell_codec none opts out (e.g. a
+    # non-f32 dtype, which the quantizers refuse).  Fabric readers
+    # negotiate the same codec — a cell serves its subscription codec
+    # only (§11.1).
+    cell_codec="",
     # Elastic gangs (mpit_tpu.ft.elastic; docs/PROTOCOL.md §9): --elastic
     # composes shardctl + the supervisor into dynamic membership.
     # elastic_spares reserves that many joiner-server rank slots beyond
@@ -200,6 +216,9 @@ def ft_from_cfg(cfg: Config):
         overrides["staleness"] = True
     if bool(cfg.get("ft_timing", False)):
         overrides["timing"] = True
+    chunk = int(cfg.get("ft_chunk_bytes", 0) or 0)
+    if chunk:
+        overrides["chunk_bytes"] = chunk
     return FTConfig.from_env(**overrides)
 
 
@@ -274,6 +293,22 @@ def _serve_vec_len(cfg: Config, rank: int) -> int:
     return int(flatten_module(module, rng, sample).w0.size)
 
 
+def cell_codec_for(cfg: Config) -> str:
+    """The cell fleet's subscription codec: ``--cell_codec`` when set,
+    else int8 — the XOR diff stream is ~4x cheaper in the int8 domain
+    and bit-exact by construction (§11.2), so compressed subscriptions
+    are the default and ``--cell_codec none`` is the opt-out.  Falls
+    back to 'none' for non-f32 dtypes (the quantizers refuse them)."""
+    from mpit_tpu.comm import codec as codec_mod
+
+    name = str(cfg.get("cell_codec", "") or "")
+    if not name:
+        dtype = str(cfg.get("dtype", "float32"))
+        name = "int8" if dtype == "float32" else "none"
+    codec_mod.get(name)  # unknown names fail at launch, not mid-gang
+    return name
+
+
 def cell_map_for(sranks: List[int], cell_ranks: List[int]) -> Dict[int, List[int]]:
     """Round-robin assignment of replica cells to server slots: cell i
     mirrors sranks[i % S], so every shard gets ceil(N/S) replicas and
@@ -303,7 +338,7 @@ def run_cell(rank: int, sranks: List[int], cell_ranks: List[int],
         rank, upstream, transport, reader_ranks,
         offset=shard.offset, size=shard.size,
         dtype=cfg.get("dtype", "float32"),
-        codec=str(cfg.get("codec", "") or "") or None,
+        codec=cell_codec_for(cfg),
         max_lag=int(cfg.get("cell_max_lag", 4)),
         ft=ft_from_cfg(cfg),
         serve=serve_cfg_for(cfg),
@@ -338,7 +373,11 @@ def run_reader(rank: int, sranks: List[int], cfg: Config,
     log = get_logger("serve", rank)
     rc = ReaderClient(
         rank, sranks, transport,
-        codec=str(cfg.get("codec", "") or "") or None,
+        # Fabric-routed readers negotiate the cells' subscription codec
+        # (a cell serves its subscription codec only, §11.1); direct
+        # readers keep the gang codec.
+        codec=(cell_codec_for(cfg) if cell_ranks
+               else str(cfg.get("codec", "") or "") or None),
         ft=ft_from_cfg(cfg),
         cells=(cell_map_for(sranks, cell_ranks) if cell_ranks else None),
     )

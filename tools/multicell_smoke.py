@@ -16,6 +16,13 @@ Asserts, loudly:
   with a STOP;
 - the obs trace of the driving process validates.
 
+The fleet subscribes with the **int8 codec by default** (ROADMAP item
+3: the XOR diff stream is ~4x cheaper in the encoded domain), and the
+bitwise assertion checks every read against the int8 round-trip of the
+expected vector — compressed subscriptions must stay bit-exact, not
+approximately right.  ``MPIT_SMOKE_CELL_CODEC=none`` keeps the fp32
+stream (the opt-out the launcher exposes as ``--cell_codec none``).
+
 Usage: python tools/multicell_smoke.py <trace_out.json> [flight_dir]
 """
 
@@ -39,6 +46,9 @@ from mpit_tpu.obs import trace as obs_trace  # noqa: E402
 from mpit_tpu.ps import ParamClient, ParamServer, ReaderClient  # noqa: E402
 
 NCELLS, NREADERS, ROUNDS, SIZE, MAX_LAG = 2, 8, 10, 16384, 4
+#: the fleet's subscription codec (int8 default — the launcher's
+#: --cell_codec default; 'none' = the opt-out)
+CODEC = os.environ.get("MPIT_SMOKE_CELL_CODEC", "int8")
 
 
 def _cell_child(rank: int, addrs, sock, reader_ranks, nranks):
@@ -48,10 +58,27 @@ def _cell_child(rank: int, addrs, sock, reader_ranks, nranks):
                       reconnect=60.0, dial_peers=list(range(rank)))
     cell = ServingCell(
         rank, 0, tr, reader_ranks, size=SIZE, max_lag=MAX_LAG,
+        codec=CODEC,
         ft=FTConfig(heartbeat_s=0.1, op_deadline_s=30.0))
     cell.start()
     tr.close()
     os._exit(0)
+
+
+def _roundtrip(vec: np.ndarray) -> np.ndarray:
+    """What a bit-exact read through a CODEC subscription must equal:
+    the decode of the upstream's encoded frame at that version (the
+    identity for codec none)."""
+    from mpit_tpu.comm import codec as codec_mod
+
+    codec = codec_mod.get(CODEC)
+    if codec.identity:
+        return vec
+    wire = np.zeros(codec.wire_nbytes(vec.size), np.uint8)
+    codec.encode_into(vec.astype(np.float32), wire)
+    out = np.empty(vec.size, np.float32)
+    codec.decode_into(wire, out)
+    return out
 
 
 def main(trace_path: str, flight_dir: str) -> int:
@@ -107,7 +134,7 @@ def main(trace_path: str, flight_dir: str) -> int:
                          connect_timeout=120.0)
         try:
             rc = ReaderClient(rank, [0], t, cells={0: cell_ranks},
-                              failover_after=2,
+                              failover_after=2, codec=CODEC,
                               ft=FTConfig(op_deadline_s=1.0,
                                           max_retries=8))
             mirror = np.zeros(SIZE, np.float32)
@@ -164,9 +191,10 @@ def main(trace_path: str, flight_dir: str) -> int:
         assert len(s["reads"]) == ROUNDS, f"reader {rank} lost reads"
         for version, lag, mirror in s["reads"]:
             total_reads += 1
-            expect = param + float(max(version - 1, 0))
+            expect = _roundtrip(param + float(max(version - 1, 0)))
             assert np.array_equal(mirror, expect), (
-                f"reader {rank} bytes differ at version {version}")
+                f"reader {rank} bytes differ at version {version} "
+                f"(codec {CODEC})")
             assert lag <= MAX_LAG, (
                 f"reader {rank} served {lag} behind head (bound {MAX_LAG})")
     evictions = int(server._m_evictions.value)
@@ -182,7 +210,8 @@ def main(trace_path: str, flight_dir: str) -> int:
         tr[r].close()
     obs_trace.write_rank_trace(trace_path, 0, role="multicell_smoke")
     tr_report = obs_trace.validate_trace(trace_path)
-    print(f"multicell-smoke OK: {NREADERS} readers x {ROUNDS} reads "
+    print(f"multicell-smoke OK (codec {CODEC}): "
+          f"{NREADERS} readers x {ROUNDS} reads "
           f"({total_reads} bitwise-checked), failovers={failovers}, "
           f"evictions={evictions}, flight dumps={len(dumps)}, trace "
           f"events={tr_report.get('events')}")

@@ -1,0 +1,144 @@
+"""CI streaming-smoke (docs/PROTOCOL.md §12): a 2-server/2-client gang
+with chunked transfers forced on, a chunk-drop FaultPlan on the data
+channels, and a modeled serial link (ft/faults.py PacedTransport) so the
+wire/apply overlap is physically real even on a 1-core runner.
+
+Asserts, loudly:
+- final params BITWISE equal to a fault-free *unchunked* control gang
+  (retry resent only missing chunks; per-(op, chunk) dedup applied each
+  exactly once);
+- chunk resends actually happened (the drop plan bit);
+- the obs trace validates, the causal analyzer joins the chunked ops,
+  and its ``streaming`` section reports ≥ 1 op with wire/apply overlap
+  — the server was applying chunk k while later chunks were still on
+  the (modeled) wire;
+- the analyzer finds zero negative-phase violations.
+
+Usage: python tools/stream_smoke.py <trace_out.json>
+"""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from mpit_tpu import obs  # noqa: E402
+from mpit_tpu.comm.local import LocalRouter  # noqa: E402
+from mpit_tpu.ft import (  # noqa: E402
+    FaultPlan,
+    FaultyTransport,
+    FTConfig,
+    PacedTransport,
+)
+from mpit_tpu.obs import causal as obs_causal  # noqa: E402
+from mpit_tpu.obs import trace as obs_trace  # noqa: E402
+from mpit_tpu.ps import ParamClient, ParamServer, tags  # noqa: E402
+
+SIZE = 64 * 1024          # 32k f32 per server -> 16 chunks of 2048
+CHUNK_BYTES = 8192
+ROUNDS = 4
+LINK_MBS = 12.0           # ~10 ms of modeled link per 128 KB chunk
+DATA_TAGS = frozenset({tags.GRAD, tags.PARAM_REQ, tags.PARAM_PUSH})
+
+
+def run_gang(chunk_bytes, drop=False, pace=False, timing=False):
+    nservers = nclients = 2
+    router = LocalRouter(nservers + nclients)
+    sranks = list(range(nservers))
+    cranks = list(range(nservers, nservers + nclients))
+    # Deadline sized to the modeled link (a full 16-chunk stream is
+    # ~170 ms of link time): long enough that only the DROPPED chunks
+    # retry, short enough that a retry's in-flight gap stays bounded.
+    ft = FTConfig(op_deadline_s=2.0, max_retries=8,
+                  backoff_base_s=0.01, backoff_cap_s=0.05,
+                  chunk_bytes=chunk_bytes, timing=timing)
+    servers, threads = [], []
+    for r in sranks:
+        servers.append(ParamServer(r, cranks, router.endpoint(r),
+                                   rule="add"))
+        threads.append(threading.Thread(target=servers[-1].start,
+                                        daemon=True))
+    for t in threads:
+        t.start()
+    rng = np.random.default_rng(1234)
+    w0 = rng.normal(size=SIZE).astype(np.float32)
+    gtab = rng.normal(size=(nclients, ROUNDS, SIZE)).astype(np.float32)
+    clients, params, starters = [], [], []
+    for i, r in enumerate(cranks):
+        ep = router.endpoint(r)
+        if pace:
+            ep = PacedTransport(ep, LINK_MBS)
+        if drop:
+            ep = FaultyTransport(ep, FaultPlan(seed=5 + i, drop_every=7,
+                                               dup_every=11,
+                                               tags=DATA_TAGS))
+        clients.append(ParamClient(r, sranks, ep,
+                                   seed_servers=(r == cranks[0]), ft=ft))
+        p = w0.copy() if i == 0 else np.zeros(SIZE, np.float32)
+        g = np.zeros(SIZE, np.float32)
+        params.append((p, g))
+        starters.append(threading.Thread(target=clients[-1].start,
+                                         args=(p, g), daemon=True))
+    for t in starters:
+        t.start()
+    for t in starters:
+        t.join(120)
+        assert not t.is_alive(), "client start hung"
+    for rnd in range(ROUNDS):
+        for i, c in enumerate(clients):
+            params[i][1][:] = gtab[i, rnd]
+            c.async_send_grad()
+            c.wait()
+    clients[0].async_recv_param()
+    clients[0].wait()
+    retries = sum(c.retries for c in clients)
+    dups = sum(s.dup_ops for s in servers)
+    for c in clients:
+        c.stop()
+    for t in threads:
+        t.join(120)
+        assert not t.is_alive(), "server never stopped"
+    return params[0][0].copy(), retries, dups
+
+
+def main(trace_path: str) -> int:
+    # Control first, with obs off — its numbers must not ride the trace.
+    control, _r, _d = run_gang(chunk_bytes=0)
+
+    obs.configure(enabled=True, reset=True)
+    final, retries, dups = run_gang(CHUNK_BYTES, drop=True, pace=True,
+                                    timing=True)
+    assert np.array_equal(control, final), (
+        "chunked+dropped run diverged from the fault-free unchunked "
+        "control — the §12 bitwise contract is broken")
+    assert retries > 0, "the chunk-drop plan never forced a resend"
+    assert dups > 0, "no duplicate chunk was ever re-acked"
+
+    obs_trace.write_rank_trace(trace_path, 0, role="stream_smoke")
+    report = obs_trace.validate_trace(trace_path)
+    analysis = obs_causal.analyze(trace_path)
+    assert not analysis["violations"], (
+        f"causal analyzer violations: {analysis['violations'][:3]}")
+    stream = analysis["streaming"]
+    assert stream and stream["ops"] > 0, (
+        "no chunked op chains in the analyzed trace")
+    assert stream["overlapped"] >= 1, (
+        f"no wire/apply overlap measured: {stream}")
+    print("stream-smoke OK: "
+          f"{stream['ops']} chunked ops, {stream['overlapped']} with "
+          f"overlap (p50 {stream['overlap_p50_us'] / 1000.0:.1f} ms, "
+          f"~{stream['chunks_p50']:.0f} chunks/op), retries={retries}, "
+          f"dups={dups}, trace events={report.get('events')}, "
+          f"join rate {analysis['ops']['join_rate']:.0%}")
+    print(json.dumps({"streaming": stream, "retries": retries,
+                      "dups": dups}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
+                  "/tmp/mpit_stream_smoke_trace.json"))
