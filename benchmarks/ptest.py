@@ -207,6 +207,28 @@ STREAM_SWEEP = os.environ.get("MPIT_BENCH_STREAM", "") not in ("", "0")
 STREAM_LINK_MBS = float(os.environ.get("MPIT_BENCH_STREAM_LINK_MBS", "800"))
 STREAM_CHUNK_MB = float(os.environ.get("MPIT_BENCH_STREAM_CHUNK_MB", "8"))
 STREAM_DEADLINE = float(os.environ.get("MPIT_BENCH_STREAM_DEADLINE", "600"))
+# MPIT_BENCH_AGG=1: the hierarchical-aggregation A/B (ISSUE 14,
+# docs/PROTOCOL.md §13.6) — a 1-server gang with MPIT_BENCH_AGG_CLIENTS
+# clients (threads in this process: the group plane needs a shared
+# backend, exactly the deployment it models) over per-endpoint modeled
+# serial links (MPIT_BENCH_AGG_LINK_MBS), run three times: flat pushes
+# (every client ships its grad upstream), prereduce (one colocated
+# group, the representative ships ONE fold), and tree (singleton reps
+# reducing through the REDUCE tree, the root ships one fold).  The
+# aggregate column is LOGICAL gradient bytes delivered per wall second
+# (nclients x payload x rounds / window): flat pays nclients upstream
+# transits of the server link per round, the hierarchical modes pay
+# one — fewer bytes upstream, not better overlap, is the lever, so
+# the hierarchical rows must beat flat by >= 1.3x (the ISSUE 14 bar).
+# Rows are tagged metric=ps_agg_hierarchy and never join the
+# codec=none baseline gate (a modeled link is not the record's wire).
+AGG_SWEEP = os.environ.get("MPIT_BENCH_AGG", "") not in ("", "0")
+AGG_CLIENTS = int(os.environ.get("MPIT_BENCH_AGG_CLIENTS", "4"))
+AGG_MB = float(os.environ.get("MPIT_BENCH_AGG_MB", "64"))
+AGG_LINK_MBS = float(os.environ.get("MPIT_BENCH_AGG_LINK_MBS", "300"))
+AGG_ROUNDS = int(os.environ.get("MPIT_BENCH_AGG_ROUNDS", "5"))
+AGG_CHUNK_MB = float(os.environ.get("MPIT_BENCH_AGG_CHUNK_MB", "4"))
+AGG_DEADLINE = float(os.environ.get("MPIT_BENCH_AGG_DEADLINE", "600"))
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
 # (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
@@ -483,6 +505,134 @@ def bench_stream() -> list:
                  f"{pair[1]['param_p50_ms']:.0f} ms")
     finally:
         NSERVERS, NCLIENTS = saved
+    return rows
+
+
+def _agg_gang_run(mode: str, size: int, codec: str = "none") -> dict:
+    """One timed aggregation leg (§13.6): 1 server + AGG_CLIENTS client
+    threads over per-endpoint PacedTransport links, AGG_ROUNDS lockstep
+    GRAD rounds.  Returns the window and per-round latencies."""
+    import numpy as np
+
+    from mpit_tpu.agg import AggClient, AggConfig
+    from mpit_tpu.comm.local import LocalRouter
+    from mpit_tpu.ft import FTConfig, LinkClock, PacedTransport
+
+    nclients = AGG_CLIENTS
+    router = LocalRouter(1 + nclients)
+    cranks = list(range(1, 1 + nclients))
+    # Chunked wire in EVERY leg (flat included — the §12 pipeline is
+    # the established baseline): the tree leg additionally streams the
+    # root's push gated on fold progress (§13.3).
+    ft = FTConfig(op_deadline_s=AGG_DEADLINE, max_retries=2,
+                  chunk_bytes=int(AGG_CHUNK_MB * (1 << 20)))
+    # ONE LinkClock across the gang: every rank's inbound NIC is one
+    # serial link shared by all its senders — the flat fan-in pays
+    # nclients transits of the server's link per round, hierarchical
+    # modes pay one (plus pipelined REDUCE hops on the clients' links).
+    link = LinkClock()
+    server_ep = PacedTransport(router.endpoint(0), AGG_LINK_MBS,
+                               min_bytes=1 << 14, link=link)
+    from mpit_tpu.ps import ParamClient, ParamServer
+
+    server = ParamServer(0, cranks, server_ep, rule="add")
+    sth = threading.Thread(target=server.start, daemon=True)
+    sth.start()
+    groups = ()
+    if mode == "prereduce":
+        groups = (tuple(cranks),)
+    cfg = AggConfig(mode=("off" if mode == "flat" else
+                          "tree" if mode == "tree" else "prereduce"),
+                    groups=groups, fanin=2, tree_seed=0,
+                    deadline_s=AGG_DEADLINE)
+    _GANG_SEQ[0] += 1
+    ns = f"aggbench{_GANG_SEQ[0]}"
+    clients, params = [], []
+    for i, r in enumerate(cranks):
+        ep = PacedTransport(router.endpoint(r), AGG_LINK_MBS,
+                            min_bytes=1 << 14, link=link)
+        inner = ParamClient(r, [0], ep, seed_servers=(i == 0), ft=ft,
+                            codec=codec or "none")
+        clients.append(AggClient(inner, cranks, cfg, namespace=ns))
+        params.append((np.zeros(size, np.float32),
+                       np.full(size, 1e-6, np.float32)))
+    barrier = threading.Barrier(nclients + 1)
+    lat = []
+
+    def drive(i, c):
+        c.start(*params[i])
+        barrier.wait()
+        for _ in range(AGG_ROUNDS):
+            s = time.monotonic()
+            c.async_send_grad()
+            c.wait()
+            if i == 0:
+                lat.append(time.monotonic() - s)
+            barrier.wait()
+
+    ths = [threading.Thread(target=drive, args=(i, c), daemon=True)
+           for i, c in enumerate(clients)]
+    for t in ths:
+        t.start()
+    barrier.wait()  # all started + seeded
+    t0 = time.time()
+    for _ in range(AGG_ROUNDS):
+        barrier.wait()  # end of each round
+    t1 = time.time()
+    for t in ths:
+        t.join(AGG_DEADLINE)
+        assert not t.is_alive(), f"agg bench driver hung (mode {mode})"
+    for c in clients:
+        c.stop()
+    sth.join(60)
+    assert not sth.is_alive(), "agg bench server never stopped"
+    return {"dt": t1 - t0, "lat": lat,
+            "applied": server.grads_applied}
+
+
+def bench_agg() -> list:
+    """The hierarchical-aggregation A/B (MPIT_BENCH_AGG, §13.6): flat
+    vs prereduce vs tree on one modeled-link gang; aggregate = logical
+    gradient bytes delivered per wall second.  The ISSUE 14 bar is the
+    hierarchical rows >= 1.3x the flat row."""
+    import numpy as np
+
+    size = int(AGG_MB * (1 << 20) / 4)
+    rows = []
+    for codec in (CODECS or ["none", "int8"]):
+        flat_mbs = None
+        for mode in ("flat", "prereduce", "tree"):
+            _log(f"[agg] {mode} codec {codec}: 1s/{AGG_CLIENTS}c "
+                 f"threads, link {AGG_LINK_MBS:.0f} MB/s, payload "
+                 f"{AGG_MB:.0f} MB x {AGG_ROUNDS} rounds")
+            r = _agg_gang_run(mode, size, codec=codec)
+            mbs = AGG_CLIENTS * AGG_ROUNDS * size * 4 / r["dt"] / 2**20
+            row = {
+                "metric": "ps_agg_hierarchy",
+                "unit": "MB/s",
+                "value": round(mbs, 1),
+                "mode": mode,
+                "codec": codec,
+                "aggregate_mbs": round(mbs, 1),
+                "round_p50_ms": round(
+                    float(np.percentile(r["lat"], 50)) * 1e3, 1),
+                "grads_applied": r["applied"],
+                "clients": AGG_CLIENTS,
+                "link_mbs": AGG_LINK_MBS,
+                "payload_mb": round(AGG_MB, 1),
+                "rounds": AGG_ROUNDS,
+            }
+            if mode == "flat":
+                flat_mbs = mbs
+            else:
+                row["speedup_vs_flat"] = round(
+                    mbs / max(flat_mbs, 1e-9), 2)
+            rows.append(row)
+            _log(f"[agg] {mode} codec {codec}: {mbs:.1f} MB/s "
+                 f"aggregate, round p50 {row['round_p50_ms']:.0f} ms, "
+                 f"applied {r['applied']}"
+                 + (f", {row['speedup_vs_flat']:.2f}x vs flat"
+                    if mode != "flat" else ""))
     return rows
 
 
@@ -1636,6 +1786,11 @@ def main():
         # FLAG_CHUNKED over the modeled serial link.  Latency-metric
         # rows on a modeled wire: never join the codec=none gate.
         results.extend(bench_stream())
+    if AGG_SWEEP and MODE in ("shm", "both"):
+        # The hierarchical-aggregation A/B (§13.6): flat vs prereduce
+        # vs tree over the modeled link.  Modeled-wire rows: never join
+        # the codec=none gate.
+        results.extend(bench_agg())
     if SKEW_SWEEP and MODE in ("shm", "both"):
         # The straggler A/B runs at codec=none (the skew is in the
         # *reply latency*, not the byte volume): rebalance off, then on.

@@ -57,14 +57,17 @@ from mpit_tpu.cells import wire as _cellwire
 from mpit_tpu.comm import codec as codec_mod
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.ft import (
+    FLAG_CHUNKED,
     FLAG_FRAMED,
     FLAG_HEARTBEAT,
     FLAG_READONLY,
     FLAG_SUBSCRIBE,
     FTConfig,
     LeaseRegistry,
+    chunk_elems_for,
     header_frame,
     init_v3,
+    init_v5,
 )
 from mpit_tpu.obs import (
     get_flight,
@@ -171,6 +174,15 @@ class ServingCell:
         self._head_fresh = time.monotonic()
         self._resyncing = False
         self._shedding = False
+        # Chunk-framed subscription (§11.6): with a chunk size in the
+        # FT posture, FULL/DELTA frames arrive as chunk messages and
+        # assemble here — one live assembly (the stream is FIFO), keyed
+        # by (kind, from, to, count) so a dropped chunk surfaces as an
+        # abandoned assembly (= a dropped frame, recovered by the
+        # existing gap/resync machinery), never a torn install.
+        self._sub_chunk_elems = (chunk_elems_for(self.ft.chunk_bytes, 4)
+                                 if self.ft.chunk_bytes > 0 else 0)
+        self._asm: Optional[Tuple[Tuple[int, int, int, int], Dict]] = None
         self._sub_epoch = self.ft.epoch
         self._sub_seq = 0
         self._hb_seq = 0
@@ -437,35 +449,75 @@ class ServingCell:
                 continue
             if got is None:
                 return
+            if self._sub_chunk_elems:
+                done = self._assemble_chunk(got)
+                if done is not None:
+                    self._apply_diff(*done)
+                continue
             kind, from_v, to_v, head, body = _cellwire.parse_diff(got)
-            self._note_head(head)
-            if kind == _cellwire.DIFF_FULL:
-                if to_v < self._snap_version:
-                    continue  # stale duplicate: versions never go back
-                self._install(body, to_v)
-                self._m_full.inc()
-                self._resyncing = False
-                self.log.info("installed FULL frame at version %d "
-                              "(head %d)", to_v, head)
-                continue
-            # DELTA
-            if self._resyncing:
-                continue  # waiting for the FULL answer
-            if self._frame is None or from_v != self._snap_version:
-                if to_v <= self._snap_version:
-                    continue  # duplicate of an already-installed step
-                self._request_resync(
-                    f"gap: delta {from_v}->{to_v} against installed "
-                    f"{self._snap_version}")
-                continue
-            if self.lag > self.resync_lag:
-                # Deep lag: replaying the backlog one delta at a time
-                # only chases a moving head — jump to it instead.
-                self._request_resync(f"lag {self.lag} > resync_lag "
-                                     f"{self.resync_lag}")
-                continue
-            self._install(_cellwire.apply_delta(self._frame, body), to_v)
-            self._m_delta.inc()
+            self._apply_diff(kind, from_v, to_v, head, body)
+
+    def _assemble_chunk(self, got):
+        """One chunked-subscription DIFF message into the live assembly
+        (§11.6).  Returns the completed (kind, from, to, head, body) or
+        None.  Duplicate chunks skip by index; a chunk of a *newer*
+        frame abandons an incomplete older assembly (the chunked analog
+        of a dropped whole frame — gap detection recovers); stragglers
+        of an older frame drop."""
+        kind, from_v, to_v, head, idx, count, body = \
+            _cellwire.parse_diff_chunk(got)
+        self._note_head(head)
+        key = (kind, from_v, to_v, count)
+        if self._asm is not None and self._asm[0] != key:
+            if to_v < self._asm[0][2]:
+                return None  # older frame's straggler chunk: drop
+            self._asm = None  # abandon the torn assembly
+        if self._asm is None:
+            self._asm = (key, {})
+        parts = self._asm[1]
+        if idx in parts:
+            return None  # duplicated chunk: already staged
+        parts[idx] = body
+        if len(parts) < count:
+            return None
+        self._asm = None
+        body = (parts[0] if count == 1
+                else np.concatenate([parts[i] for i in range(count)]))
+        return kind, from_v, to_v, head, body
+
+    def _apply_diff(self, kind: int, from_v: int, to_v: int, head: int,
+                    body: np.ndarray) -> None:
+        """Install one assembled FULL/DELTA frame — the §11.2 chain
+        arithmetic: FULL never goes backwards, DELTA only extends the
+        installed version exactly, anything else resyncs."""
+        self._note_head(head)
+        if kind == _cellwire.DIFF_FULL:
+            if to_v < self._snap_version:
+                return  # stale duplicate: versions never go back
+            self._install(body, to_v)
+            self._m_full.inc()
+            self._resyncing = False
+            self.log.info("installed FULL frame at version %d "
+                          "(head %d)", to_v, head)
+            return
+        # DELTA
+        if self._resyncing:
+            return  # waiting for the FULL answer
+        if self._frame is None or from_v != self._snap_version:
+            if to_v <= self._snap_version:
+                return  # duplicate of an already-installed step
+            self._request_resync(
+                f"gap: delta {from_v}->{to_v} against installed "
+                f"{self._snap_version}")
+            return
+        if self.lag > self.resync_lag:
+            # Deep lag: replaying the backlog one delta at a time
+            # only chases a moving head — jump to it instead.
+            self._request_resync(f"lag {self.lag} > resync_lag "
+                                 f"{self.resync_lag}")
+            return
+        self._install(_cellwire.apply_delta(self._frame, body), to_v)
+        self._m_delta.inc()
 
     def _beat_service(self):
         """Subscriber heartbeats: renew the upstream lease, drain the
@@ -514,8 +566,8 @@ class ServingCell:
         the per-cell stream to a FULL frame on every (re)attach."""
         self._sub_epoch += 1
         self._resyncing = True
-        cinfo = init_v3(self.offset, self.size, self.codec.wire_id,
-                        self._sub_epoch, self._sub_flags())
+        self._asm = None
+        cinfo = self._announce()
         try:
             yield from aio_send(self.transport, cinfo, self.upstream,
                                 tags.INIT, live=self.live,
@@ -528,7 +580,18 @@ class ServingCell:
 
     def _sub_flags(self) -> int:
         return (FLAG_FRAMED | FLAG_READONLY | FLAG_SUBSCRIBE
-                | FLAG_HEARTBEAT)
+                | FLAG_HEARTBEAT
+                | (FLAG_CHUNKED if self._sub_chunk_elems else 0))
+
+    def _announce(self) -> np.ndarray:
+        """The subscription INIT: v5 (carrying the chunk cut) for a
+        chunk-framed stream, the byte-identical v3 otherwise."""
+        if self._sub_chunk_elems:
+            return init_v5(self.offset, self.size, self.codec.wire_id,
+                           self._sub_epoch, self._sub_flags(),
+                           self._sub_chunk_elems)
+        return init_v3(self.offset, self.size, self.codec.wire_id,
+                       self._sub_epoch, self._sub_flags())
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -540,8 +603,7 @@ class ServingCell:
     def start(self) -> None:
         """Run the cell to completion: subscribe, serve, stop when
         every expected reader is terminal (or on :meth:`shutdown`)."""
-        cinfo = init_v3(self.offset, self.size, self.codec.wire_id,
-                        self._sub_epoch, self._sub_flags())
+        cinfo = self._announce()
         self.sched.spawn(
             aio_send(self.transport, cinfo, self.upstream, tags.INIT,
                      live=self.live,

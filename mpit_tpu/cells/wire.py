@@ -100,6 +100,63 @@ def parse_diff(payload) -> Tuple[int, int, int, int, np.ndarray]:
     return kind, from_v, to_v, head, body
 
 
+#: chunked-subscription DIFF header (docs/PROTOCOL.md §11.6): int64
+#: [kind, from_version, to_version, head_version, nbytes, chunk_idx,
+#: chunk_count] — a FULL/DELTA body split into chunk_count independent
+#: messages so a 640 MB resync never head-of-line-blocks the stream.
+#: Sent ONLY to cells whose subscription negotiated FLAG_CHUNKED (the
+#: per-cell format is fixed by negotiation — small frames ship as a
+#: single chunk message, never the 5-word legacy form).
+DIFF_CHUNK_HDR_WORDS = 7
+DIFF_CHUNK_HDR_BYTES = 8 * DIFF_CHUNK_HDR_WORDS
+
+
+def pack_diff_chunks(kind: int, from_version: int, to_version: int,
+                     head_version: int, body: np.ndarray,
+                     chunk_bytes: int) -> "list[np.ndarray]":
+    """One DIFF frame as its chunk-message sequence: byte-granular cuts
+    (XOR deltas have no block structure to respect), each message fresh
+    and self-describing, FIFO on the one DIFF channel.  Assembly is
+    plain concatenation; a lost chunk surfaces exactly like a lost
+    whole frame — a broken chain recovered by DIFF_REQ."""
+    body_u8 = as_u8(body)
+    cut = max(int(chunk_bytes), 1)
+    count = max((body_u8.size + cut - 1) // cut, 1)
+    msgs = []
+    for idx in range(count):
+        piece = body_u8[idx * cut:(idx + 1) * cut]
+        out = np.empty(DIFF_CHUNK_HDR_BYTES + piece.size, np.uint8)
+        out[:DIFF_CHUNK_HDR_BYTES].view(np.int64)[:] = (
+            kind, from_version, to_version, head_version, piece.size,
+            idx, count)
+        out[DIFF_CHUNK_HDR_BYTES:] = piece
+        msgs.append(out)
+    return msgs
+
+
+def parse_diff_chunk(payload) -> Tuple[int, int, int, int, int, int,
+                                       np.ndarray]:
+    """(kind, from_version, to_version, head_version, chunk_idx,
+    chunk_count, body) from one chunked-subscription DIFF message."""
+    raw = np.frombuffer(bytes(payload), np.uint8)
+    if raw.size < DIFF_CHUNK_HDR_BYTES:
+        raise ValueError(
+            f"chunked DIFF message too short: {raw.size} bytes (need "
+            f"the {DIFF_CHUNK_HDR_BYTES}-byte header)")
+    kind, from_v, to_v, head, nbytes, idx, count = (
+        int(x) for x in raw[:DIFF_CHUNK_HDR_BYTES].view(np.int64))
+    if kind not in (DIFF_FULL, DIFF_DELTA):
+        raise ValueError(f"unknown DIFF kind {kind}")
+    body = raw[DIFF_CHUNK_HDR_BYTES:]
+    if body.size != nbytes:
+        raise ValueError(
+            f"chunked DIFF body is {body.size} bytes but the header "
+            f"promised {nbytes}")
+    if not (0 <= idx < count):
+        raise ValueError(f"chunk {idx} outside count {count}")
+    return kind, from_v, to_v, head, idx, count, body
+
+
 def xor_delta(frame_from: np.ndarray, frame_to: np.ndarray) -> np.ndarray:
     """The DELTA body: byte-wise XOR of two same-version-stream encoded
     frames.  Fails loudly on a size mismatch — frames of one (codec,

@@ -29,6 +29,7 @@ from mpit_tpu.ft.elastic import ElasticDirectory, PreemptionNotice
 from mpit_tpu.ft.faults import (
     FaultPlan,
     FaultyTransport,
+    LinkClock,
     PacedTransport,
     inject_preemption,
 )

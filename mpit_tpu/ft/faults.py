@@ -287,6 +287,30 @@ class FaultyTransport(Transport):
         self.inner.close()
 
 
+class LinkClock:
+    """A shared serial-link reservation clock: PacedTransport instances
+    constructed with the same clock model ONE physical link per
+    destination — e.g. a server's inbound NIC shared by a fan-in of
+    senders (the §13.6 aggregation A/B), where each sender's private
+    pacer would wrongly grant the fan-in N parallel links.  Thread-safe:
+    sender threads reserve atomically."""
+
+    def __init__(self):
+        import threading
+
+        self._free: dict = {}
+        self._lock = threading.Lock()
+
+    def reserve(self, dst: int, seconds: float) -> float:
+        """Claim ``seconds`` of dst's link; returns the completion
+        time (monotonic)."""
+        with self._lock:
+            now = _time.monotonic()
+            due = max(now, self._free.get(dst, now)) + seconds
+            self._free[dst] = due
+            return due
+
+
 class PacedTransport(Transport):
     """A store-and-forward *link model*: every outbound message to a peer
     transits a serial link of ``rate_mbs`` megabytes/second, so a
@@ -309,15 +333,18 @@ class PacedTransport(Transport):
 
     def __init__(self, inner: Transport, rate_mbs: float,
                  min_bytes: int = 4096,
-                 tags: "Optional[frozenset]" = None):
+                 tags: "Optional[frozenset]" = None,
+                 link: "Optional[LinkClock]" = None):
         self.inner = inner
         self.rank = inner.rank
         self.nranks = inner.nranks
         self.rate = float(rate_mbs) * (1 << 20)
         self.min_bytes = int(min_bytes)
         self.tags = tags
-        #: dst -> monotonic time the modeled link to it frees up
-        self._free: dict = {}
+        #: the per-dst link reservation clock; pass a shared LinkClock
+        #: to make several transports contend for one physical link
+        #: per destination (fan-in modeling, §13.6)
+        self._link = link if link is not None else LinkClock()
         #: dst -> deque of (due, data, tag, proxy Handle) awaiting post
         self._queued: dict = {}
 
@@ -339,9 +366,7 @@ class PacedTransport(Transport):
         if (tag < 0 or nbytes < self.min_bytes
                 or (self.tags is not None and tag not in self.tags)):
             return self.inner.isend(data, dst, tag)
-        now = _time.monotonic()
-        due = max(now, self._free.get(dst, now)) + nbytes / self.rate
-        self._free[dst] = due
+        due = self._link.reserve(dst, nbytes / self.rate)
         proxy = Handle(kind="send", peer=dst, tag=tag, buf=data,
                        meta={"paced": True})
         self._queued.setdefault(dst, []).append((due, data, tag, proxy))

@@ -490,12 +490,47 @@ def streaming_overlap(chain: Chain,
     }
 
 
+def aggregation_section(rows: List[Span]) -> Optional[dict]:
+    """The §13 REDUCE summary: reduction rounds per rank, fan-in, the
+    fold→forward window, stragglers excluded and fallbacks taken.
+    REDUCE spans are client↔client — they never join a server half, so
+    they get this section instead of entering the join-rate accounting
+    (which would read every reduction as a failed join)."""
+    if not rows:
+        return None
+    walls = sorted(s.t1 - s.t0 for s in rows)
+    folds = []
+    for s in rows:
+        start = s.mark_ts("fold", last=False)
+        end = s.mark_ts("forward") or s.mark_ts("send") or s.t1
+        if start is not None and end is not None and end >= start:
+            folds.append(end - start)
+    fanins = sorted(float(s.args.get("nfold", 0)) for s in rows
+                    if s.args.get("nfold"))
+    return {
+        "rounds": len(rows),
+        "ranks": len({s.args.get("rank") for s in rows}),
+        "ok": sum(1 for s in rows if s.outcome == "ok"),
+        "late_folds": int(sum(float(s.args.get("late", 0))
+                              + float(s.args.get("group_late", 0))
+                              for s in rows)),
+        "fallbacks": sum(1 for s in rows if s.args.get("fallback")),
+        "fanin_p50": _percentile(fanins, 0.50) if fanins else 0.0,
+        "wall_p50_us": _percentile(walls, 0.50),
+        "fold_p50_us": _percentile(sorted(folds), 0.50) if folds else 0.0,
+    }
+
+
 def analyze(path_or_obj, min_join: float = 0.0) -> dict:
     """The full analysis of one trace.  Returns the report dict (the
     ``--json`` payload); rendering and exit-code policy live in
     :func:`main`."""
     events, other = load_trace(path_or_obj)
     spans = extract_spans(events)
+    # REDUCE spans (§13) are summarized separately — a reduction hop has
+    # no server half to join.
+    agg_rows = [s for s in spans if s.name == "REDUCE"]
+    spans = [s for s in spans if s.name != "REDUCE"]
     chains, _unkeyed = join_spans(spans)
     offsets = OffsetTable(chains, other)
     decomposed = [d for d in (decompose(c, offsets) for c in chains)
@@ -587,6 +622,7 @@ def analyze(path_or_obj, min_join: float = 0.0) -> dict:
         "dominant_phases": dominant,
         "critical_path": critical,
         "streaming": streaming,
+        "aggregation": aggregation_section(agg_rows),
         "slowest": slowest,
         "violations": violations,
         "chains": decomposed,
@@ -698,6 +734,14 @@ def render_report(report: dict, top: int = 5) -> str:
             f"(overlap p50 {stream['overlap_p50_us'] / 1000.0:.3f}ms, "
             f"p90 {stream['overlap_p90_us'] / 1000.0:.3f}ms, "
             f"~{stream['chunks_p50']:.0f} chunks/op)")
+    agg = report.get("aggregation")
+    if agg:
+        lines.append(
+            f"aggregation: {agg['rounds']} reduce round(s) across "
+            f"{agg['ranks']} rank(s), fan-in p50 {agg['fanin_p50']:.0f}, "
+            f"fold p50 {agg['fold_p50_us'] / 1000.0:.3f}ms, "
+            f"late folds {agg['late_folds']}, "
+            f"fallbacks {agg['fallbacks']}")
     for d in report["slowest"][:top]:
         decomp = "  ".join(f"{phase}={d['phases'][phase] / 1000.0:.3f}"
                            for phase in PHASES if d["phases"][phase] > 0)

@@ -217,6 +217,14 @@ def _rank_row(rank: int, sample: Optional[dict],
         "cell_lag": int(metric_sum(m, "mpit_cell_lag")),
         "readers": int(metric_sum(m, "mpit_ps_readers")),
         "reroutes": int(metric_sum(m, "mpit_ps_reader_reroutes_total")),
+        # Aggregation columns (PROTOCOL.md §13): a reducing client rank
+        # publishes its last round's fan-in, the contributions it
+        # excluded at its straggler deadline, and the direct-push
+        # fallbacks it took after being excluded itself.
+        "agg_fanin": int(metric_sum(m, "mpit_agg_fanin")),
+        "agg_late": int(metric_sum(m, "mpit_agg_late_folds_total")),
+        "agg_fallbacks": int(
+            metric_sum(m, "mpit_agg_direct_fallbacks_total")),
         "inflight": len(status.get("inflight_ops") or []),
     }
     # SLO columns (ISSUE 11): BUSY-reply ratio (admission rejections
@@ -288,7 +296,8 @@ def render_autoscale_line(section: Optional[dict]) -> str:
 _COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "slo", "busy%",
             "sendq", "conns",
             "busy", "stale", "retry", "evict", "shards", "busy_s", "mapv",
-            "gang", "cellv", "lag", "rdrs", "rrt", "infl")
+            "gang", "cellv", "lag", "rdrs", "rrt", "fanin", "late", "fb",
+            "infl")
 
 
 def render_table(rows: List[Dict[str, object]]) -> str:
@@ -325,6 +334,11 @@ def render_table(rows: List[Dict[str, object]]) -> str:
             (str(row["cell_lag"]) if row.get("role") == "cell" else "-"),
             str(row["readers"]) if row.get("readers") else "-",
             str(row["reroutes"]) if row.get("reroutes") else "-",
+            # Aggregation columns (§13): only meaningful on reducing
+            # client ranks — everyone else shows '-'.
+            str(row["agg_fanin"]) if row.get("agg_fanin") else "-",
+            str(row["agg_late"]) if row.get("agg_late") else "-",
+            str(row["agg_fallbacks"]) if row.get("agg_fallbacks") else "-",
             str(row["inflight"]),
         ]
 

@@ -348,6 +348,8 @@ class ParamServer:
                                        rank=_r, kind="full")
         self._m_diff_delta = _m.counter("mpit_ps_diffs_sent_total",
                                         rank=_r, kind="delta")
+        self._m_diff_chunks = _m.counter("mpit_ps_diff_chunks_sent_total",
+                                         rank=_r)
         self._m_evictions = _m.counter("mpit_ft_evictions_total", rank=_r)
         self._m_sc_nacks = _m.counter("mpit_shardctl_nacks_sent_total",
                                       rank=_r)
@@ -637,14 +639,15 @@ class ParamServer:
             )
         self._framed[crank] = bool(flags & FLAG_FRAMED)
         self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
-        # Pipelined streaming (§12): a writer-role, framed-only posture.
+        # Pipelined streaming (§12): a framed posture — the writer path,
+        # plus chunk-framed diff streams for SUBSCRIBE cells (§11.6).
         if chunked:
-            if ro or sub:
+            if ro and not sub:
                 raise ValueError(
-                    f"rank {crank} announced FLAG_CHUNKED with a "
-                    "READONLY/SUBSCRIBE posture — reads are served by "
-                    "the §8 dispatcher and cells by the diff stream; "
-                    "chunked streaming is the writer path (§12.1)")
+                    f"rank {crank} announced FLAG_CHUNKED with the "
+                    "READONLY posture — reads are served by the §8 "
+                    "dispatcher; chunked streaming is the writer path "
+                    "(§12.1) or a chunk-framed subscription (§11.6)")
             if not self._framed[crank]:
                 raise ValueError(
                     f"client {crank} announced FLAG_CHUNKED without "
@@ -655,7 +658,8 @@ class ParamServer:
                     f"client {crank} announced chunk_elems={chunk_elems}; "
                     f"must be a positive multiple of {codec_mod.BLOCK} "
                     "(the codec block boundary, §12.2)")
-            self._require_splittable_rule(crank)
+            if not sub:
+                self._require_splittable_rule(crank)
         self._chunk[crank] = chunk_elems if chunked else 0
         # Staleness telemetry only rides the framed wire: the version
         # word extends the [epoch, seq] header, so a FLAG_STALENESS
@@ -1659,13 +1663,16 @@ class ParamServer:
                    if c in self._codecs and not self.leases.gone(c))
         self._m_cells.set(live)
 
-    def _cell_frame(self, crank: int) -> "Optional[np.ndarray]":
-        """The next DIFF message for one subscriber: a DELTA against the
-        last version shipped to it when the history still holds that
-        frame, else a FULL frame at the head.  Either way the head
-        frame comes out of (and is recorded into) the same snapshot
-        cache wire reads share — N same-codec cells cost one encode and
-        one XOR per committed version, not N."""
+    def _cell_frame(self, crank: int) -> "List[np.ndarray]":
+        """The next DIFF message sequence for one subscriber: a DELTA
+        against the last version shipped to it when the history still
+        holds that frame, else a FULL frame at the head — as ONE
+        message, or as chunk messages when the subscription negotiated
+        FLAG_CHUNKED (§11.6: a 640 MB resync must not head-of-line-
+        block the stream).  Either way the head frame comes out of (and
+        is recorded into) the same snapshot cache wire reads share — N
+        same-codec cells cost one encode and one XOR per committed
+        version, not N."""
         codec = self._codecs[crank]
         head = self._snap_version
         wire = self._snapshot_wire(codec)
@@ -1677,26 +1684,38 @@ class ParamServer:
         sent = self._cell_sent.get(crank, -1)
         if 0 <= sent < head and hist.has(sent):
             self._m_diff_delta.inc()
-            return _cellwire.pack_diff(
-                _cellwire.DIFF_DELTA, sent, head, head,
-                hist.delta(sent, head))
-        self._m_diff_full.inc()
-        return _cellwire.pack_diff(_cellwire.DIFF_FULL, -1, head, head,
-                                   wire)
+            kind, from_v = _cellwire.DIFF_DELTA, sent
+            body = hist.delta(sent, head)
+        else:
+            self._m_diff_full.inc()
+            kind, from_v = _cellwire.DIFF_FULL, -1
+            body = wire
+        chunk_elems = self._chunk.get(crank, 0)
+        if chunk_elems:
+            msgs = _cellwire.pack_diff_chunks(kind, from_v, head, head,
+                                              body, 4 * chunk_elems)
+            self._m_diff_chunks.inc(len(msgs))
+            return msgs
+        return [_cellwire.pack_diff(kind, from_v, head, head, body)]
 
-    def _cell_push(self, crank: int, gen: int, frame: np.ndarray,
+    def _cell_push(self, crank: int, gen: int, frames: "List[np.ndarray]",
                    push_live: Dict[int, bool]):
         """One in-flight diff push to one cell (FIFO per cell: the next
         frame waits until this one is accepted, so the stream coalesces
         to head under backpressure instead of queueing every version).
+        A chunk-framed subscription ships the frame as its message
+        sequence on the same FIFO channel — `chunk`-marked per message.
         A cell that dies mid-push costs this task, never the server."""
         span = self._spans.op("DIFF", peer=crank, side="server",
                               rank=self.rank)
         try:
             span.mark("send")
-            yield from aio_send(self.transport, frame, crank, tags.DIFF,
-                                live=self.live,
-                                abort=self._svc_abort(crank, gen))
+            for i, frame in enumerate(frames):
+                if i:
+                    span.mark("chunk")
+                yield from aio_send(self.transport, frame, crank,
+                                    tags.DIFF, live=self.live,
+                                    abort=self._svc_abort(crank, gen))
         except (RuntimeError, DeadlineExceeded) as exc:
             self.log.debug("diff to cell %d dropped: %r", crank, exc)
             span.end("aborted")

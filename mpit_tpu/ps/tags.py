@@ -67,6 +67,24 @@ DIFF_REQ = 15  # cell -> server: int64 [epoch, seq, have_version] — the
 #                dropped DELTA: from_version != the installed version)
 #                or the cell fell beyond its resync horizon; the server
 #                answers with a FULL frame at the current head.
+REDUCE = 16  # client -> client: one partial-gradient chunk frame of the
+#              hierarchical aggregation tree (docs/PROTOCOL.md §13):
+#              int64 [epoch, seq, chunk_idx, chunk_count, nfold] then
+#              the chunk's codec frame, padded to the uniform stride.
+#              ``nfold`` is the number of leaf contributions already
+#              folded into the partial; the receiving interior node
+#              folds the decoded chunk into its own partial sum in
+#              fixed child-rank order and forwards chunk k upstream
+#              while chunk k+1 is still arriving.
+REDUCE_ACK = 17  # client -> client: int64 [epoch, seq, chunk_idx,
+#                  status] — per-admitted-chunk ack on the REDUCE hop.
+#                  status OK means received (retries resend only
+#                  unacked chunks, the §12 discipline); status LATE
+#                  means the round already folded without this sender
+#                  (straggler deadline fired) — the sender must fall
+#                  back to a direct GRAD push of its partial, so a
+#                  late contribution is counted and re-routed, never
+#                  silently dropped and never double-folded.
 
 EMPTY = b""  # the canonical 0-byte payload
 
@@ -97,4 +115,10 @@ TAG_PAIRS = {
     # are validated against this table + PROTOCOL.md (MT-P5xx).
     "DIFF": ("server", "cell"),
     "DIFF_REQ": ("cell", "server"),
+    # Hierarchical aggregation (docs/PROTOCOL.md §13): reduction-tree
+    # hops travel client<->client — like the server<->server shard
+    # handoff, these rows live outside the binary client<->server role
+    # model and are validated against this table + PROTOCOL.md.
+    "REDUCE": ("client", "client"),
+    "REDUCE_ACK": ("client", "client"),
 }

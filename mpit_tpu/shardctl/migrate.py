@@ -51,6 +51,18 @@ from mpit_tpu.utils.checkpoint import (
 #: loud DeadlineExceeded, never a wedged server.
 SC_DEADLINE_S = float(os.environ.get("MPIT_SC_DEADLINE_S", "60"))
 
+#: chunk cut for the SHARD_STATE param-byte leg (ROADMAP item 1 / §12's
+#: streaming applied to migration): a big shard's bytes ship as
+#: ceil(n/chunk) messages on the same FIFO channel instead of one, so
+#: the wire moves chunk k while the source stages k+1 and the whole
+#: transfer never sits behind a single monolithic send — the freeze
+#: window shrinks to roughly one chunk of latency plus the wire time.
+#: The chunk list travels in the meta JSON, so both sides agree without
+#: negotiation and a small shard (or 0 = disabled) keeps the original
+#: single-message wire byte-for-byte.
+SC_CHUNK_BYTES = int(os.environ.get("MPIT_SC_CHUNK_BYTES",
+                                    str(4 << 20)))
+
 
 class ShardSlot:
     """One owned shard on a server: device state + serving caches."""
@@ -103,12 +115,21 @@ class ShardSlot:
 # SHARD_STATE wire sequence
 
 
-def pack_shard_state(slot: ShardSlot) -> List[np.ndarray]:
+def pack_shard_state(slot: ShardSlot,
+                     chunk_bytes: Optional[int] = None) -> List[np.ndarray]:
     """The SHARD_STATE message sequence for one frozen slot: meta JSON,
-    param bytes, then each rule-state array in meta key order."""
+    param bytes (as chunk messages when the shard exceeds the chunk
+    cut — zero-copy views of the snapshot, so chunking costs nothing),
+    then each rule-state array in meta key order."""
     host = slot.snapshot_host()
+    cut = SC_CHUNK_BYTES if chunk_bytes is None else int(chunk_bytes)
     state = dict(slot.rule_state or {})
     state_np = {k: np.asarray(v) for k, v in state.items()}
+    pbytes = host.view(np.uint8).reshape(-1)
+    chunks: List[np.ndarray] = []
+    if cut > 0 and pbytes.size > cut:
+        chunks = [pbytes[lo:lo + cut]
+                  for lo in range(0, pbytes.size, cut)]
     meta = {
         "shard_id": slot.shard_id,
         "offset": slot.offset,
@@ -121,8 +142,12 @@ def pack_shard_state(slot: ShardSlot) -> List[np.ndarray]:
         "state_dtypes": {k: str(v.dtype) for k, v in state_np.items()},
         "state_shapes": {k: list(v.shape) for k, v in state_np.items()},
     }
-    msgs = [np.frombuffer(json.dumps(meta).encode(), np.uint8),
-            host.view(np.uint8).reshape(-1)]
+    if chunks:
+        # Both sides derive the assembly from the meta — no negotiation,
+        # and an unchunked sequence stays byte-for-byte the legacy wire.
+        meta["param_chunks"] = [int(c.size) for c in chunks]
+    msgs = [np.frombuffer(json.dumps(meta).encode(), np.uint8)]
+    msgs.extend(chunks if chunks else [pbytes])
     for key in meta["state_keys"]:
         arr = np.ascontiguousarray(state_np[key])
         msgs.append(arr.view(np.uint8).reshape(-1))
@@ -147,11 +172,35 @@ def recv_shard_state(transport, src: int, live, deadline=None, abort=None):
     slot.grads_applied = int(meta["grads_applied"])
     slot.dedup.restore(meta.get("dedup") or {})
     pdtype = resolve_dtype(meta["param_dtype"])
-    raw = yield from aio_recv(transport, src, tags.SHARD_STATE, live=live,
-                              deadline=deadline, abort=abort)
-    if raw is None:
-        return None
-    slot.param = np.frombuffer(bytes(raw), pdtype).copy()
+    chunk_sizes = meta.get("param_chunks")
+    if chunk_sizes:
+        # Chunked param leg: assemble in arrival order (one FIFO
+        # channel — order is the transport's) into exactly-sized
+        # staging; bit-identity with the unchunked wire is plain
+        # concatenation.
+        buf = np.empty(sum(int(n) for n in chunk_sizes), np.uint8)
+        at = 0
+        for nbytes in chunk_sizes:
+            raw = yield from aio_recv(transport, src, tags.SHARD_STATE,
+                                      live=live, deadline=deadline,
+                                      abort=abort)
+            if raw is None:
+                return None
+            view = np.frombuffer(bytes(raw), np.uint8)
+            if view.size != int(nbytes):
+                raise ValueError(
+                    f"SHARD_STATE chunk size mismatch: expected {nbytes}"
+                    f" bytes, got {view.size}")
+            buf[at:at + view.size] = view
+            at += view.size
+        slot.param = buf.view(pdtype).copy()
+    else:
+        raw = yield from aio_recv(transport, src, tags.SHARD_STATE,
+                                  live=live, deadline=deadline,
+                                  abort=abort)
+        if raw is None:
+            return None
+        slot.param = np.frombuffer(bytes(raw), pdtype).copy()
     state: Dict[str, np.ndarray] = {}
     for key in meta["state_keys"]:
         raw = yield from aio_recv(transport, src, tags.SHARD_STATE,

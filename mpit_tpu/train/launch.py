@@ -179,6 +179,25 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     autoscale_flap_budget=3,
     autoscale_min_servers=1,
     autoscale_max_servers=0,  # 0 = every provisioned server slot
+    # Hierarchical aggregation (mpit_tpu.agg; docs/PROTOCOL.md §13):
+    # --agg off|prereduce|tree.  prereduce folds colocated client
+    # groups on-device behind a representative; tree additionally
+    # reduces representatives through a deterministic REDUCE tree so
+    # the servers see ONE gradient per round for the whole gang.
+    # agg_groups declares colocation ("4,5;6,7" — ranks sharing a
+    # process/backend; empty = every client its own representative),
+    # verified against the dplane fingerprint at start.  Requires
+    # ft_op_deadline_s > 0 (REDUCE hops ride the framed retry/dedup
+    # machinery); off under shardctl and --dplane (the exchange client
+    # wraps the same seam).  agg_deadline_s is the straggler wall
+    # deadline (§13.4); agg_chunk_bytes cuts the REDUCE hops (0 =
+    # ft_chunk_bytes, then 1 MiB).
+    agg="off",
+    agg_groups="",
+    agg_fanin=2,
+    agg_tree_seed=0,
+    agg_deadline_s=5.0,
+    agg_chunk_bytes=0,
     # Device-resident data plane (mpit_tpu.dplane; docs/DEVICE.md):
     # servers hold shard + optimizer state as (mesh-sharded) HBM arrays
     # with donated jitted applies and publish an in-process device
@@ -191,6 +210,16 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     # device exchange.
     dplane=0,
 )
+
+
+def parse_agg_groups(spec: str) -> "Tuple[Tuple[int, ...], ...]":
+    """--agg_groups "4,5;6,7" -> ((4, 5), (6, 7)): semicolon-separated
+    colocation groups of comma-separated client ranks (PROTOCOL.md
+    §13.0).  Empty spec = no declared colocation (every client its own
+    representative)."""
+    return tuple(
+        tuple(int(x) for x in part.split(",") if x.strip() != "")
+        for part in spec.split(";") if part.strip())
 
 
 def ft_from_cfg(cfg: Config):
@@ -722,6 +751,28 @@ def run_rank(
         from mpit_tpu.dplane import ExchangeClient
 
         pclient = ExchangeClient(pclient)
+    agg_mode = str(cfg.get("agg", "off") or "off")
+    if agg_mode != "off":
+        from mpit_tpu.agg import AggClient, AggConfig
+
+        if sc_on:
+            raise ValueError("--agg composes with the static shard map "
+                             "only (run without --shardctl/--elastic)")
+        if int(cfg.get("dplane", 0)):
+            raise ValueError("--agg and --dplane both wrap the client "
+                             "data path; pick one")
+        if float(cfg.get("ft_op_deadline_s", 0) or 0) <= 0:
+            raise ValueError("--agg needs --ft_op_deadline_s > 0: REDUCE "
+                             "hops ride the framed retry machinery")
+        groups = parse_agg_groups(str(cfg.get("agg_groups", "") or ""))
+        pclient = AggClient(
+            pclient, cranks,
+            AggConfig(mode=agg_mode, groups=groups,
+                      fanin=int(cfg.get("agg_fanin", 2)),
+                      tree_seed=int(cfg.get("agg_tree_seed", 0)),
+                      deadline_s=float(cfg.get("agg_deadline_s", 5.0)),
+                      chunk_bytes=int(cfg.get("agg_chunk_bytes", 0))),
+            namespace=str(cfg.get("namespace", "") or ""))
     trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
     log.info("worker with servers %s", sranks)
     return {"role": "worker", **trainer.run()}

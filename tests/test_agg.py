@@ -1,0 +1,565 @@
+"""Hierarchical quantized aggregation (docs/PROTOCOL.md §13).
+
+The contract under test: pre-reducing colocated gradients on the group
+plane and reducing across the REDUCE tree changes *who sends what where*
+and nothing else — the value the server applies is bitwise the fixed-
+order fold of the gang's gradients (per-hop codec round-trips included),
+whatever the arrival order, tree shape, or chunk-level fault pattern.
+Stragglers re-route loudly (LATE -> direct push), never silently and
+never as a hang.
+
+The oracle below replays the plan's fold in plain numpy — same codec
+code, same fixed order — and a flat control gang pushes the oracle's
+values; the hierarchical gang's final params must equal the control's
+bitwise.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.agg import (
+    AggClient,
+    AggConfig,
+    ReductionPlan,
+    pack_reduce_header,
+    reduce_ack_frame,
+    unpack_reduce_header,
+)
+from mpit_tpu.comm import codec as codec_mod
+from mpit_tpu.comm.local import LocalRouter
+from mpit_tpu.ft import (
+    FaultPlan,
+    FaultyTransport,
+    FTConfig,
+    RetryExhausted,
+    chunk_elems_for,
+)
+from mpit_tpu.aio import TaskError
+from mpit_tpu.ps import ParamClient, ParamServer, tags
+
+REDUCE_TAGS = frozenset({tags.REDUCE})
+REDUCE_ACK_TAGS = frozenset({tags.REDUCE_ACK})
+
+_ns_counter = [0]
+
+
+def agg_ft(deadline=2.0, retries=10, chunk_bytes=0):
+    return FTConfig(op_deadline_s=deadline, max_retries=retries,
+                    backoff_base_s=0.005, backoff_cap_s=0.02,
+                    chunk_bytes=chunk_bytes)
+
+
+def join_all(threads, timeout=90):
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "role thread did not stop (hang)"
+
+
+# ---------------------------------------------------------------------------
+# plan units
+
+
+class TestReductionPlan:
+    def test_singleton_groups_and_reps(self):
+        plan = ReductionPlan.build([2, 3, 4, 5])
+        assert all(plan.is_rep(r) for r in [2, 3, 4, 5])
+        assert plan.root in [2, 3, 4, 5]
+        # every non-root rep has a parent; edges are acyclic and reach
+        # the root
+        for r in [2, 3, 4, 5]:
+            hops, node = 0, r
+            while plan.parent(node) is not None:
+                node = plan.parent(node)
+                hops += 1
+                assert hops <= 4
+            assert node == plan.root
+
+    def test_groups_elect_min_rank(self):
+        plan = ReductionPlan.build([2, 3, 4, 5], groups=[(3, 2), (5, 4)])
+        assert plan.rep(2) == 2 and plan.rep(3) == 2
+        assert plan.rep(4) == 4 and plan.rep(5) == 4
+        assert plan.members(2) == [3]
+        assert not plan.is_rep(3)
+        assert plan.group_size(5) == 2
+
+    def test_deterministic_and_seed_sensitive(self):
+        a = ReductionPlan.build(range(8), fanin=2, seed=1)
+        b = ReductionPlan.build(range(8), fanin=2, seed=1)
+        assert a.parent_of == b.parent_of
+        shapes = {tuple(sorted(ReductionPlan.build(
+            range(8), fanin=2, seed=s).parent_of.items()))
+            for s in range(6)}
+        assert len(shapes) > 1  # seeds actually vary the tree
+
+    def test_subtree_leaves_counts_everyone(self):
+        plan = ReductionPlan.build(range(6), groups=[(0, 1, 2)], fanin=2)
+        assert plan.subtree_leaves(plan.root) == 6
+
+    def test_overlapping_groups_rejected(self):
+        with pytest.raises(ValueError, match="two groups"):
+            ReductionPlan.build(range(4), groups=[(0, 1), (1, 2)])
+
+    def test_unknown_rank_rejected(self):
+        with pytest.raises(ValueError, match="non-client"):
+            ReductionPlan.build([0, 1], groups=[(0, 7)])
+
+    def test_fanin_shapes(self):
+        plan = ReductionPlan.build(range(9), fanin=8, seed=0)
+        # fanin 8 over 9 reps: the root takes all 8 others
+        assert len(plan.children(plan.root)) == 8
+
+
+# ---------------------------------------------------------------------------
+# wire units
+
+
+class TestReduceWire:
+    def test_header_roundtrip(self):
+        buf = np.zeros(64, np.uint8)
+        pack_reduce_header(buf, 3, 7, 2, 5, 11)
+        assert unpack_reduce_header(buf) == (3, 7, 2, 5, 11)
+
+    def test_ack_frame(self):
+        frame = reduce_ack_frame(1, 2, 3, 1)
+        assert frame.dtype == np.int64
+        assert list(frame) == [1, 2, 3, 1]
+
+
+# ---------------------------------------------------------------------------
+# the gang harness: per-client driver threads, lockstep rounds
+
+
+def launch_agg(nservers, nclients, ft, cfg, client_plans=None,
+               server_plan=None, rule="add", codec=None):
+    n = nservers + nclients
+    router = LocalRouter(n)
+    sranks = list(range(nservers))
+    cranks = list(range(nservers, n))
+    _ns_counter[0] += 1
+    namespace = f"test{_ns_counter[0]}"
+    servers, threads = [], []
+    for r in sranks:
+        ep = router.endpoint(r)
+        if server_plan is not None:
+            ep = FaultyTransport(ep, server_plan)
+        servers.append(ParamServer(r, cranks, ep, rule=rule,
+                                   ft=FTConfig(rejoin=True)))
+        threads.append(threading.Thread(target=servers[-1].start,
+                                        daemon=True))
+    for t in threads:
+        t.start()
+    clients = []
+    for i, r in enumerate(cranks):
+        ep = router.endpoint(r)
+        plan = (client_plans or {}).get(i)
+        if plan is not None:
+            ep = FaultyTransport(ep, plan)
+        inner = ParamClient(r, sranks, ep, seed_servers=(r == cranks[0]),
+                            codec=codec, ft=ft)
+        clients.append(AggClient(inner, cranks, cfg, namespace=namespace))
+    return servers, clients, threads
+
+
+class PingBarrier:
+    """A lockstep barrier whose waiters keep pumping their client's
+    I/O: an idle tree parent must still answer a straggler's retries
+    (LATE acks), exactly as a real training loop's ping cadence does."""
+
+    def __init__(self, n):
+        self.n = n
+        self._count = 0
+        self._gen = 0
+        self._aborted = False
+        self._lock = threading.Lock()
+
+    def abort(self):
+        self._aborted = True
+
+    def wait(self, ping=None, timeout=90.0):
+        with self._lock:
+            gen = self._gen
+            self._count += 1
+            if self._count == self.n:
+                self._count = 0
+                self._gen += 1
+                return
+        bound = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._gen != gen:
+                    return
+            if self._aborted:
+                raise RuntimeError("agg barrier aborted (sibling failed)")
+            if ping is not None:
+                ping()
+            time.sleep(0.001)
+            if time.monotonic() > bound:
+                self._aborted = True
+                raise RuntimeError("agg barrier timed out")
+
+
+def run_agg_gang(nservers, nclients, ft, cfg, rounds=3, size=8192,
+                 client_plans=None, server_plan=None, rule="add",
+                 codec=None, seed=42, gtab=None, delays=None,
+                 w0=None, round_timeout=90):
+    """Seed, run lockstep rounds from per-client driver threads (the
+    tree needs every client pumping concurrently), read back client 0's
+    params.  ``delays[(client_idx, round)]`` sleeps that client before
+    its send — the straggler injection.  Returns (params, stats)."""
+    rng = np.random.default_rng(seed)
+    drawn = rng.normal(size=size).astype(np.float32)
+    if w0 is None:
+        w0 = drawn
+    if gtab is None:
+        gtab = rng.normal(size=(nclients, max(rounds, 1), size)).astype(
+            np.float32)
+    servers, clients, threads = launch_agg(
+        nservers, nclients, ft, cfg, client_plans=client_plans,
+        server_plan=server_plan, rule=rule, codec=codec)
+    barrier = PingBarrier(nclients)
+    errors = {}
+    params = []
+    for i in range(nclients):
+        p = w0.copy() if i == 0 else np.zeros(size, np.float32)
+        params.append((p, np.zeros(size, np.float32)))
+
+    def drive(i, c):
+        try:
+            c.start(*params[i])
+            barrier.wait(ping=c.ping)
+            for r in range(rounds):
+                params[i][1][:] = gtab[i, r]
+                if delays:
+                    time.sleep(delays.get((i, r), 0.0))
+                c.async_send_grad()
+                c.wait()
+                barrier.wait(ping=c.ping)
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            errors[i] = exc
+            barrier.abort()
+
+    drivers = [threading.Thread(target=drive, args=(i, c), daemon=True)
+               for i, c in enumerate(clients)]
+    for t in drivers:
+        t.start()
+    deadline = time.monotonic() + round_timeout
+    for t in drivers:
+        t.join(max(deadline - time.monotonic(), 1.0))
+        assert not t.is_alive(), "agg driver hung (never-hang broken)"
+    try:
+        if errors:
+            raise errors[min(errors)]
+        clients[0].async_recv_param()
+        clients[0].wait()
+        stats = {
+            "applied": sum(s.grads_applied for s in servers),
+            "dups": sum(s.dup_ops for s in servers),
+            "retries": sum(c.retries for c in clients),
+            "late": sum(
+                int(c._m_late.value) for c in clients),
+            "fallbacks": sum(
+                int(c._m_fallbacks.value) for c in clients),
+        }
+        return params[0][0].copy(), stats
+    finally:
+        for c in clients:
+            try:
+                c.stop()
+            except Exception:
+                pass
+        for s in servers:
+            s.live.stop()
+        join_all(threads)
+
+
+# ---------------------------------------------------------------------------
+# the numpy oracle: the plan's fixed-order fold, codec hops included
+
+
+def oracle_pushes(plan, gtab, codec_name, rounds, size):
+    """Per round, the value the root pushes upstream: group folds in
+    ascending rank order, child subtrees folded in ascending child
+    order, every tree hop round-tripped through the codec with the
+    sender-held error-feedback residual."""
+    codec = codec_mod.get(codec_name)
+    cranks = plan.cranks
+    idx = {r: i for i, r in enumerate(cranks)}
+    residuals = {r: np.zeros(size, np.float32) for r in cranks}
+
+    def fold(rank, r):
+        acc = gtab[idx[rank], r].astype(np.float32).copy()
+        for m in plan.members(rank):
+            acc += gtab[idx[m], r]
+        for c in plan.children(rank):
+            sub = fold(c, r)
+            wire = np.zeros(codec.wire_nbytes(size), np.uint8)
+            codec.encode_into(
+                sub, wire,
+                residual=residuals[c] if codec.uses_residual else None)
+            dec = np.zeros(size, np.float32)
+            codec.decode_into(wire, dec)
+            acc += dec
+        return acc
+
+    return [fold(plan.root, r) for r in range(rounds)]
+
+
+def run_flat_control(nservers, pushes, ft, size, rule="add", codec=None,
+                     seed=42):
+    """A 1-client flat gang pushing the oracle's per-round values —
+    the 'flat pushes under a fixed reduction order' baseline."""
+    rng = np.random.default_rng(seed)
+    w0 = rng.normal(size=size).astype(np.float32)
+    gtab = np.stack([pushes])  # (1, rounds, size)
+    return run_agg_gang(nservers, 1, ft, AggConfig(mode="off"),
+                        rounds=len(pushes), size=size, rule=rule,
+                        codec=codec, seed=seed, gtab=gtab)
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity: hierarchical == flat pushes of the fixed-order fold
+
+
+class TestHierarchicalBitwise:
+    @pytest.mark.parametrize("codec_name", ["none", "bf16", "int8"])
+    def test_tree_equals_flat_fold(self, codec_name):
+        """4 singleton clients over a binary tree: the root's pushes —
+        per-hop codec round-trips included — land bitwise-identical to
+        a flat client pushing the oracle fold."""
+        size = 8192
+        cfg = AggConfig(mode="tree", fanin=2, tree_seed=3,
+                        deadline_s=30.0)
+        plan = ReductionPlan.build(range(2, 6), fanin=2, seed=3)
+        rng = np.random.default_rng(42)
+        rng.normal(size=size)  # skip w0 draw: gtab must match run's
+        gtab = rng.normal(size=(4, 3, size)).astype(np.float32)
+        hier, st = run_agg_gang(2, 4, agg_ft(), cfg, rounds=3, size=size,
+                                codec=codec_name, gtab=gtab)
+        pushes = oracle_pushes(plan, gtab, codec_name, 3, size)
+        flat, _ = run_flat_control(2, pushes, agg_ft(), size,
+                                   codec=codec_name)
+        np.testing.assert_array_equal(hier, flat)
+        assert st["applied"] == 3 * 2  # one GRAD per round per server
+        assert st["late"] == 0 and st["fallbacks"] == 0
+
+    def test_prereduce_group_equals_flat_sum(self):
+        """One colocated group of 3: the representative pushes the
+        on-device group fold; servers see exactly one GRAD per round."""
+        size = 6144
+        cfg = AggConfig(mode="prereduce", groups=((2, 3, 4),),
+                        deadline_s=30.0)
+        rng = np.random.default_rng(42)
+        rng.normal(size=size)
+        gtab = rng.normal(size=(3, 2, size)).astype(np.float32)
+        hier, st = run_agg_gang(2, 3, agg_ft(), cfg, rounds=2, size=size,
+                                gtab=gtab)
+        plan = ReductionPlan.build(range(2, 5), groups=[(2, 3, 4)])
+        pushes = oracle_pushes(plan, gtab, "none", 2, size)
+        flat, _ = run_flat_control(2, pushes, agg_ft(), size)
+        np.testing.assert_array_equal(hier, flat)
+        assert st["applied"] == 2 * 2
+
+    def test_tree_with_groups_and_stateful_rule(self):
+        """2 groups + a tree over their reps, rmsprop server rule —
+        the fold value is what reaches the rule, bitwise."""
+        size = 6144
+        groups = ((2, 3), (4, 5))
+        cfg = AggConfig(mode="tree", groups=groups, fanin=2,
+                        tree_seed=1, deadline_s=30.0)
+        rng = np.random.default_rng(42)
+        rng.normal(size=size)
+        gtab = rng.normal(size=(4, 3, size)).astype(np.float32)
+        hier, _ = run_agg_gang(2, 4, agg_ft(), cfg, rounds=3, size=size,
+                               rule="rmsprop", codec="int8", gtab=gtab)
+        plan = ReductionPlan.build(range(2, 6), groups=groups, fanin=2,
+                                   seed=1)
+        pushes = oracle_pushes(plan, gtab, "int8", 3, size)
+        flat, _ = run_flat_control(2, pushes, agg_ft(), size,
+                                   rule="rmsprop", codec="int8")
+        np.testing.assert_array_equal(hier, flat)
+
+    def test_chunked_upstream_push_composes(self):
+        """FLAG_CHUNKED on the client<->server wire + the REDUCE tree:
+        chunking never changes bytes, so the fold still matches the
+        unchunked control bitwise."""
+        size = 8192
+        cfg = AggConfig(mode="tree", fanin=2, tree_seed=0,
+                        deadline_s=30.0, chunk_bytes=8192)
+        rng = np.random.default_rng(42)
+        rng.normal(size=size)
+        gtab = rng.normal(size=(3, 2, size)).astype(np.float32)
+        hier, _ = run_agg_gang(1, 3, agg_ft(chunk_bytes=8192), cfg,
+                               rounds=2, size=size, gtab=gtab)
+        plan = ReductionPlan.build(range(1, 4), fanin=2, seed=0)
+        pushes = oracle_pushes(plan, gtab, "none", 2, size)
+        flat, _ = run_flat_control(1, pushes, agg_ft(), size)
+        np.testing.assert_array_equal(hier, flat)
+
+    def test_off_mode_is_flat_passthrough(self):
+        size = 4096
+        rng = np.random.default_rng(42)
+        rng.normal(size=size)
+        gtab = rng.normal(size=(2, 2, size)).astype(np.float32)
+        flat_raw, _ = run_agg_gang(1, 2, agg_ft(), AggConfig(mode="off"),
+                                   rounds=2, size=size, gtab=gtab)
+        # flat: both clients push their own grads (2 applies per round)
+        assert flat_raw is not None
+
+
+# ---------------------------------------------------------------------------
+# straggler handling: loud, counted, re-routed, never lost, never a hang
+
+
+class TestStragglers:
+    def test_late_member_falls_back_to_direct_push(self):
+        """A colocated member sleeping past the deadline: the rep folds
+        without it, the member direct-pushes.  Integer grads make float
+        addition exact, so the final params still carry every
+        contribution regardless of apply order."""
+        size = 4096
+        cfg = AggConfig(mode="prereduce", groups=((1, 2),),
+                        deadline_s=0.4)
+        rng = np.random.default_rng(42)
+        rng.normal(size=size)
+        w0 = rng.integers(-64, 65, size=size).astype(np.float32)
+        gtab = rng.integers(-8, 9, size=(2, 2, size)).astype(np.float32)
+        final, st = run_agg_gang(
+            1, 2, agg_ft(), cfg, rounds=2, size=size, gtab=gtab, w0=w0,
+            delays={(1, 0): 1.2})
+        expect = w0 + gtab.sum(axis=(0, 1))
+        np.testing.assert_array_equal(final, expect)
+        assert st["late"] >= 1, "the exclusion was never counted"
+        assert st["fallbacks"] >= 1, "the member never re-routed"
+
+    def test_late_tree_child_falls_back(self):
+        """A tree leaf sleeping past the deadline: its parent folds
+        without it (LATE acks), the leaf direct-pushes its partial."""
+        size = 4096
+        cfg = AggConfig(mode="tree", fanin=2, tree_seed=0,
+                        deadline_s=0.4)
+        plan = ReductionPlan.build(range(1, 4), fanin=2, seed=0)
+        # pick a non-root leaf to straggle
+        leaf = next(r for r in plan.cranks
+                    if plan.parent(r) is not None and not plan.children(r))
+        leaf_idx = plan.cranks.index(leaf)
+        rng = np.random.default_rng(42)
+        rng.normal(size=size)
+        w0 = rng.integers(-64, 65, size=size).astype(np.float32)
+        gtab = rng.integers(-8, 9, size=(3, 2, size)).astype(np.float32)
+        final, st = run_agg_gang(
+            1, 3, agg_ft(), cfg, rounds=2, size=size, gtab=gtab, w0=w0,
+            delays={(leaf_idx, 0): 1.5})
+        expect = w0 + gtab.sum(axis=(0, 1))
+        np.testing.assert_array_equal(final, expect)
+        assert st["late"] >= 1
+        assert st["fallbacks"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# faults on the REDUCE hops: retries recover, bitwise holds
+
+
+class TestReduceFaults:
+    def test_drop_dup_on_reduce_hops_bitwise(self):
+        """Every 3rd REDUCE chunk dropped + every 4th duplicated on
+        every client, every 5th ack dropped: the resend/dedup
+        discipline recovers and the fold stays bitwise — a generous
+        straggler deadline keeps faults from masquerading as
+        stragglers."""
+        size = 8192
+        cfg = AggConfig(mode="tree", fanin=2, tree_seed=2,
+                        deadline_s=30.0)
+        rng = np.random.default_rng(42)
+        rng.normal(size=size)
+        gtab = rng.normal(size=(4, 2, size)).astype(np.float32)
+        plans = {
+            i: FaultPlan(seed=5 + i, drop_every=3, dup_every=4,
+                         tags=REDUCE_TAGS | REDUCE_ACK_TAGS)
+            for i in range(4)
+        }
+        hier, st = run_agg_gang(2, 4, agg_ft(deadline=0.3), cfg,
+                                rounds=2, size=size, gtab=gtab,
+                                client_plans=plans)
+        plan = ReductionPlan.build(range(2, 6), fanin=2, seed=2)
+        pushes = oracle_pushes(plan, gtab, "none", 2, size)
+        flat, _ = run_flat_control(2, pushes, agg_ft(), size)
+        np.testing.assert_array_equal(hier, flat)
+        assert st["late"] == 0 and st["fallbacks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the §13 property test (ISSUE 14 satellite): seeds x tree shapes x plans
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_property_reduce_faults_bitwise_or_loud(seed):
+    """≥5 seeds × random tree shapes × random {drop, dup, delay} plans
+    on the REDUCE hops: the gang either completes with final params
+    bitwise-equal to the flat fixed-order-fold control — int8 EF hops
+    included — or fails loudly.  Never a hang: drivers run under a hard
+    timeout inside run_agg_gang."""
+    rng = np.random.default_rng(seed)
+    nclients = int(rng.integers(3, 6))
+    fanin = int(rng.choice([1, 2, 3]))
+    tree_seed = int(rng.integers(0, 100))
+    codec_name = str(rng.choice(["none", "int8"]))
+    size = int(rng.choice([6144, 8192]))
+    rounds = 2
+    cfg = AggConfig(mode="tree", fanin=fanin, tree_seed=tree_seed,
+                    deadline_s=30.0)
+    grng = np.random.default_rng(42)
+    grng.normal(size=size)
+    gtab = grng.normal(size=(nclients, rounds, size)).astype(np.float32)
+    plans = {
+        i: FaultPlan(seed=seed * 17 + i, drop_rate=0.10, dup_rate=0.08,
+                     delay_rate=0.15, delay_polls=4,
+                     tags=REDUCE_TAGS | REDUCE_ACK_TAGS)
+        for i in range(nclients)
+    }
+    try:
+        hier, st = run_agg_gang(
+            2, nclients, agg_ft(deadline=0.3, retries=8), cfg,
+            rounds=rounds, size=size, gtab=gtab, client_plans=plans,
+            codec=codec_name, round_timeout=120)
+    except (TaskError, RetryExhausted, AssertionError):
+        return  # loud is an acceptable outcome; a hang is not
+    plan = ReductionPlan.build(range(2, 2 + nclients), fanin=fanin,
+                               seed=tree_seed)
+    pushes = oracle_pushes(plan, gtab, codec_name, rounds, size)
+    flat, _ = run_flat_control(2, pushes, agg_ft(), size,
+                               codec=codec_name)
+    if st["fallbacks"] == 0 and st["late"] == 0:
+        np.testing.assert_array_equal(hier, flat)
+
+
+# ---------------------------------------------------------------------------
+# launcher wiring (--agg)
+
+
+class TestLaunchWiring:
+    def test_parse_agg_groups(self):
+        from mpit_tpu.train.launch import parse_agg_groups
+
+        assert parse_agg_groups("") == ()
+        assert parse_agg_groups("4,5;6,7") == ((4, 5), (6, 7))
+        assert parse_agg_groups(" 2 , 3 ; 9 ") == ((2, 3), (9,))
+
+    def test_agg_requires_framed_wire(self):
+        inner = ParamClient(1, [0], LocalRouter(2).endpoint(1))
+        with pytest.raises(ValueError, match="op_deadline_s"):
+            AggClient(inner, [1], AggConfig(mode="tree"))
+
+    def test_agg_rejects_shardctl(self):
+        inner = ParamClient(1, [0], LocalRouter(2).endpoint(1),
+                            shardctl=True,
+                            ft=FTConfig(op_deadline_s=1.0))
+        with pytest.raises(ValueError, match="shard map"):
+            AggClient(inner, [1], AggConfig(mode="prereduce"))
+
+    def test_off_mode_needs_no_ft(self):
+        inner = ParamClient(1, [0], LocalRouter(2).endpoint(1))
+        agg = AggClient(inner, [1], AggConfig(mode="off"))
+        assert agg.plan is None  # strict passthrough

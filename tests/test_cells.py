@@ -44,6 +44,24 @@ class TestDiffWire:
                                  np.zeros(0, np.uint8))
         assert cellwire.parse_diff(msg)[4].size == 0
 
+    def test_chunked_pack_parse_roundtrip(self):
+        """§11.6: a frame's chunk-message sequence reassembles to the
+        exact body; a small body ships as one chunk message."""
+        body = np.arange(100, dtype=np.uint8)
+        msgs = cellwire.pack_diff_chunks(cellwire.DIFF_DELTA, 3, 5, 7,
+                                         body, chunk_bytes=40)
+        assert len(msgs) == 3
+        pieces = []
+        for i, msg in enumerate(msgs):
+            kind, f, t, head, idx, count, piece = \
+                cellwire.parse_diff_chunk(msg)
+            assert (kind, f, t, head) == (cellwire.DIFF_DELTA, 3, 5, 7)
+            assert (idx, count) == (i, 3)
+            pieces.append(piece)
+        np.testing.assert_array_equal(np.concatenate(pieces), body)
+        assert len(cellwire.pack_diff_chunks(
+            cellwire.DIFF_FULL, -1, 1, 1, body, chunk_bytes=1024)) == 1
+
     def test_malformed_frames_are_loud(self):
         with pytest.raises(ValueError, match="too short"):
             cellwire.parse_diff(b"\x00" * 8)
@@ -251,7 +269,8 @@ class _Gang:
     """1 server (rank 0) + 1 writer (rank 1) + N cells + M readers."""
 
     def __init__(self, ncells=2, nreaders=2, *, server_wrap=None,
-                 max_lag=4, cell_hb=0.05, server_ft=None):
+                 max_lag=4, cell_hb=0.05, server_ft=None,
+                 cell_chunk_bytes=0):
         self.ncells, self.nreaders = ncells, nreaders
         core = 2 + ncells
         self.nranks = core + nreaders
@@ -270,7 +289,8 @@ class _Gang:
             cell = ServingCell(
                 c, 0, self.tr[c], reader_ranks=self.reader_ranks,
                 size=SIZE, max_lag=max_lag,
-                ft=FTConfig(heartbeat_s=cell_hb, op_deadline_s=10.0))
+                ft=FTConfig(heartbeat_s=cell_hb, op_deadline_s=10.0,
+                            chunk_bytes=cell_chunk_bytes))
             self.cells[c] = cell
 
             def run(cell=cell):
@@ -378,6 +398,70 @@ class TestFabric:
             # the upstream's PARAM serves came from the writer only
             # (its read_params during start); readers never touched it.
             assert gang.server.params_served <= 2
+        finally:
+            gang.close()
+
+    def test_chunk_framed_subscription_bitwise(self):
+        """§11.6: a FLAG_CHUNKED subscription receives FULL/DELTA
+        frames as chunk messages (SIZE=2048 f32 at a 4 KiB cut = 2
+        chunks per frame) — reads stay bit-for-bit the upstream
+        snapshot, and the server actually shipped chunk messages."""
+        gang = _Gang(ncells=2, nreaders=2, cell_chunk_bytes=4096)
+        try:
+            gang.commit(3)
+            out = {}
+            rth = [threading.Thread(target=_reader,
+                                    args=(gang, r, 4, out))
+                   for r in gang.reader_ranks]
+            for t in rth:
+                t.start()
+            gang.commit(3)
+            for t in rth:
+                t.join(60)
+                assert not t.is_alive(), "reader hung"
+            chunks_sent = int(gang.server._m_diff_chunks.value)
+            gang.finish()
+            for r in gang.reader_ranks:
+                rec = out[r]
+                assert not rec["errors"]
+                assert rec["monotone"]
+                for v, _lags, mirror in rec["reads"]:
+                    np.testing.assert_array_equal(mirror,
+                                                  gang.expected(v))
+            assert chunks_sent >= 2, (
+                "no chunk messages shipped — the subscription never "
+                "negotiated FLAG_CHUNKED?")
+            for cell in gang.cells.values():
+                assert cell.version == gang.server._snap_version
+        finally:
+            gang.close()
+
+    def test_chunk_framed_subscription_survives_chunk_drops(self):
+        """Chunk-level drop/dup on the DIFF channel: a torn frame is
+        exactly a dropped frame — the gap/resync machinery recovers
+        and every installed version stays bit-exact."""
+        def wrap(t):
+            return FaultyTransport(t, FaultPlan(seed=3, drop_every=5,
+                                               dup_every=4,
+                                               tags=frozenset({tags.DIFF})))
+
+        gang = _Gang(ncells=1, nreaders=1, cell_chunk_bytes=4096,
+                     server_wrap=wrap)
+        try:
+            for _ in range(6):
+                gang.commit(1)
+                time.sleep(0.05)
+            deadline = time.monotonic() + 20
+            cell = gang.cells[2]
+            while time.monotonic() < deadline and \
+                    cell.version < gang.server._snap_version:
+                time.sleep(0.05)
+            assert cell.version >= 1, "cell never installed a frame"
+            np.testing.assert_array_equal(
+                np.frombuffer(bytes(cell._frame), np.float32),
+                gang.expected(cell.version))
+            cell.shutdown()  # no reader ever attaches in this leg
+            gang.finish()
         finally:
             gang.close()
 

@@ -1,0 +1,235 @@
+"""CI aggregation smoke (docs/PROTOCOL.md §13): 2 servers + 4 clients —
+ranks 2/3 colocated behind one representative (group plane), ranks 4/5
+reducing through the REDUCE tree — int8 quantized hops, plus a
+straggler leg with an injected delay past the deadline.
+
+Asserts, loudly:
+- fault-free leg: final params BITWISE equal to a flat control gang
+  pushing the plan's fixed-order fold (per-hop int8 EF round-trips
+  replayed by a plain-numpy oracle);
+- straggler leg: ≥ 1 late fold counted, ≥ 1 direct-push fallback
+  taken, and (integer-valued gradients — float addition exact and
+  order-free) final params still carry EVERY contribution;
+- the obs trace validates, and the causal analyzer's ``aggregation``
+  section reports the reduce rounds and the late folds with zero
+  negative-phase violations.
+
+Usage: python tools/agg_smoke.py <trace_out.json>
+"""
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from mpit_tpu import obs  # noqa: E402
+from mpit_tpu.agg import AggClient, AggConfig, ReductionPlan  # noqa: E402
+from mpit_tpu.comm import codec as codec_mod  # noqa: E402
+from mpit_tpu.comm.local import LocalRouter  # noqa: E402
+from mpit_tpu.ft import FTConfig  # noqa: E402
+from mpit_tpu.obs import causal as obs_causal  # noqa: E402
+from mpit_tpu.obs import trace as obs_trace  # noqa: E402
+from mpit_tpu.ps import ParamClient, ParamServer  # noqa: E402
+
+SIZE = 16 * 1024
+ROUNDS = 3
+NSERVERS = 2
+NCLIENTS = 4
+GROUPS = ((2, 3),)
+FANIN = 2
+TREE_SEED = 1
+
+
+def smoke_ft():
+    return FTConfig(op_deadline_s=2.0, max_retries=8,
+                    backoff_base_s=0.01, backoff_cap_s=0.05)
+
+
+class PingBarrier:
+    """Lockstep barrier whose waiters pump their client's I/O (an idle
+    tree parent must keep answering a straggler's retries)."""
+
+    def __init__(self, n):
+        self.n = n
+        self._count = 0
+        self._gen = 0
+        self._lock = threading.Lock()
+
+    def wait(self, ping=None, timeout=90.0):
+        with self._lock:
+            gen = self._gen
+            self._count += 1
+            if self._count == self.n:
+                self._count = 0
+                self._gen += 1
+                return
+        bound = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._gen != gen:
+                    return
+            if ping is not None:
+                ping()
+            time.sleep(0.001)
+            assert time.monotonic() < bound, "smoke barrier timed out"
+
+
+def run_gang(cfg, gtab, w0, codec=None, delays=None, namespace=""):
+    n = NSERVERS + gtab.shape[0]
+    router = LocalRouter(n)
+    sranks = list(range(NSERVERS))
+    cranks = list(range(NSERVERS, n))
+    servers, threads = [], []
+    for r in sranks:
+        servers.append(ParamServer(r, cranks, router.endpoint(r),
+                                   rule="add"))
+        threads.append(threading.Thread(target=servers[-1].start,
+                                        daemon=True))
+    for t in threads:
+        t.start()
+    clients, params = [], []
+    for i, r in enumerate(cranks):
+        inner = ParamClient(r, sranks, router.endpoint(r),
+                            seed_servers=(r == cranks[0]), codec=codec,
+                            ft=smoke_ft())
+        clients.append(AggClient(inner, cranks, cfg, namespace=namespace))
+        p = w0.copy() if i == 0 else np.zeros(SIZE, np.float32)
+        params.append((p, np.zeros(SIZE, np.float32)))
+    barrier = PingBarrier(len(clients))
+    errors = {}
+
+    def drive(i, c):
+        try:
+            c.start(*params[i])
+            barrier.wait(ping=c.ping)
+            for rnd in range(gtab.shape[1]):
+                params[i][1][:] = gtab[i, rnd]
+                if delays:
+                    time.sleep(delays.get((i, rnd), 0.0))
+                c.async_send_grad()
+                c.wait()
+                barrier.wait(ping=c.ping)
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            errors[i] = exc
+
+    drivers = [threading.Thread(target=drive, args=(i, c), daemon=True)
+               for i, c in enumerate(clients)]
+    for t in drivers:
+        t.start()
+    for t in drivers:
+        t.join(120)
+        assert not t.is_alive(), "agg smoke driver hung (never-hang!)"
+    if errors:
+        raise errors[min(errors)]
+    clients[0].async_recv_param()
+    clients[0].wait()
+    stats = {
+        "late": sum(int(c._m_late.value) for c in clients),
+        "fallbacks": sum(int(c._m_fallbacks.value) for c in clients),
+        "applied": sum(s.grads_applied for s in servers),
+    }
+    final = params[0][0].copy()
+    for c in clients:
+        c.stop()
+    for t in threads:
+        t.join(120)
+        assert not t.is_alive(), "server never stopped"
+    return final, stats
+
+
+def oracle_pushes(plan, gtab, codec_name):
+    codec = codec_mod.get(codec_name)
+    cranks = plan.cranks
+    idx = {r: i for i, r in enumerate(cranks)}
+    residuals = {r: np.zeros(SIZE, np.float32) for r in cranks}
+
+    def fold(rank, rnd):
+        acc = gtab[idx[rank], rnd].copy()
+        for m in plan.members(rank):
+            acc += gtab[idx[m], rnd]
+        for c in plan.children(rank):
+            sub = fold(c, rnd)
+            wire = np.zeros(codec.wire_nbytes(SIZE), np.uint8)
+            codec.encode_into(
+                sub, wire,
+                residual=residuals[c] if codec.uses_residual else None)
+            dec = np.zeros(SIZE, np.float32)
+            codec.decode_into(wire, dec)
+            acc += dec
+        return acc
+
+    return [fold(plan.root, rnd) for rnd in range(gtab.shape[1])]
+
+
+def main(trace_path: str) -> int:
+    rng = np.random.default_rng(777)
+    w0 = rng.normal(size=SIZE).astype(np.float32)
+    gtab = rng.normal(size=(NCLIENTS, ROUNDS, SIZE)).astype(np.float32)
+    plan = ReductionPlan.build(
+        range(NSERVERS, NSERVERS + NCLIENTS), groups=GROUPS, fanin=FANIN,
+        seed=TREE_SEED)
+    print("reduction plan:\n" + plan.describe())
+
+    # Flat control + the fault-free bitwise leg run with obs off: two
+    # gangs reuse the same [epoch, seq] identities, so only ONE gang —
+    # the straggler leg below — may ride the analyzed trace.
+    pushes = np.stack([oracle_pushes(plan, gtab, "int8")])
+    control, _ = run_gang(AggConfig(mode="off"), pushes, w0,
+                          codec="int8", namespace="ctl")
+
+    cfg = AggConfig(mode="tree", groups=GROUPS, fanin=FANIN,
+                    tree_seed=TREE_SEED, deadline_s=20.0)
+    final, st = run_gang(cfg, gtab, w0, codec="int8", namespace="hier")
+    assert np.array_equal(control, final), (
+        "hierarchical int8 run diverged from the flat fixed-order-fold "
+        "control — the §13 bitwise contract is broken")
+    assert st["late"] == 0 and st["fallbacks"] == 0, st
+    assert st["applied"] == ROUNDS * NSERVERS, (
+        f"expected one fold per round per server, got {st['applied']}")
+
+    # Straggler leg: a non-root contributor sleeps past the deadline on
+    # round 0.  Integer-valued grads + w0 make float addition exact and
+    # order-free, so 'nothing lost' is assertable bitwise even though
+    # the direct push lands as a second apply.
+    iw0 = rng.integers(-64, 65, size=SIZE).astype(np.float32)
+    igtab = rng.integers(-8, 9, size=(NCLIENTS, 2, SIZE)).astype(
+        np.float32)
+    straggler = next(r for r in plan.cranks
+                     if plan.parent(r) is not None
+                     and not plan.children(r))
+    obs.configure(enabled=True, reset=True)
+    scfg = AggConfig(mode="tree", groups=GROUPS, fanin=FANIN,
+                     tree_seed=TREE_SEED, deadline_s=0.5)
+    sfinal, sst = run_gang(
+        scfg, igtab, iw0,
+        delays={(plan.cranks.index(straggler), 0): 1.5},
+        namespace="strag")
+    np.testing.assert_array_equal(sfinal, iw0 + igtab.sum(axis=(0, 1)))
+    assert sst["late"] >= 1, "the straggler was never counted late"
+    assert sst["fallbacks"] >= 1, "the straggler never re-routed"
+
+    obs_trace.write_rank_trace(trace_path, 0, role="agg_smoke")
+    report = obs_trace.validate_trace(trace_path)
+    analysis = obs_causal.analyze(trace_path)
+    assert not analysis["violations"], (
+        f"causal analyzer violations: {analysis['violations'][:3]}")
+    agg = analysis["aggregation"]
+    assert agg and agg["rounds"] > 0, "no REDUCE spans in the trace"
+    assert agg["late_folds"] >= 1, f"late fold missing from trace: {agg}"
+    print("agg-smoke OK: "
+          f"{agg['rounds']} reduce rounds across {agg['ranks']} ranks, "
+          f"fan-in p50 {agg['fanin_p50']:.0f}, "
+          f"late={agg['late_folds']}, fallbacks={agg['fallbacks']}, "
+          f"straggler leg late={sst['late']}/fb={sst['fallbacks']}, "
+          f"trace events={report.get('events')}")
+    print(json.dumps({"aggregation": agg, "straggler": sst}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
+                  "/tmp/mpit_agg_smoke_trace.json"))

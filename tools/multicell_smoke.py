@@ -21,7 +21,10 @@ The fleet subscribes with the **int8 codec by default** (ROADMAP item
 bitwise assertion checks every read against the int8 round-trip of the
 expected vector — compressed subscriptions must stay bit-exact, not
 approximately right.  ``MPIT_SMOKE_CELL_CODEC=none`` keeps the fp32
-stream (the opt-out the launcher exposes as ``--cell_codec none``).
+stream (the opt-out the launcher exposes as ``--cell_codec none``);
+``MPIT_SMOKE_CELL_CHUNK`` (default 8192) chunk-frames the diff
+subscription (§11.6) and every read's bit-exactness check asserts the
+assembly — 0 opts back into whole-frame diffs.
 
 Usage: python tools/multicell_smoke.py <trace_out.json> [flight_dir]
 """
@@ -49,6 +52,11 @@ NCELLS, NREADERS, ROUNDS, SIZE, MAX_LAG = 2, 8, 10, 16384, 4
 #: the fleet's subscription codec (int8 default — the launcher's
 #: --cell_codec default; 'none' = the opt-out)
 CODEC = os.environ.get("MPIT_SMOKE_CELL_CODEC", "int8")
+#: chunk-framed subscriptions (PROTOCOL.md §11.6): the cells announce
+#: FLAG_CHUNKED at this cut so FULL/DELTA frames ship as chunk
+#: messages — bit-exactness of every read below asserts the assembly;
+#: 0 keeps the legacy whole-frame stream.
+CHUNK = int(os.environ.get("MPIT_SMOKE_CELL_CHUNK", "8192"))
 
 
 def _cell_child(rank: int, addrs, sock, reader_ranks, nranks):
@@ -59,7 +67,8 @@ def _cell_child(rank: int, addrs, sock, reader_ranks, nranks):
     cell = ServingCell(
         rank, 0, tr, reader_ranks, size=SIZE, max_lag=MAX_LAG,
         codec=CODEC,
-        ft=FTConfig(heartbeat_s=0.1, op_deadline_s=30.0))
+        ft=FTConfig(heartbeat_s=0.1, op_deadline_s=30.0,
+                    chunk_bytes=CHUNK))
     cell.start()
     tr.close()
     os._exit(0)
@@ -199,6 +208,11 @@ def main(trace_path: str, flight_dir: str) -> int:
                 f"reader {rank} served {lag} behind head (bound {MAX_LAG})")
     evictions = int(server._m_evictions.value)
     assert evictions >= 1, "the killed cell was never evicted by lease"
+    diff_chunks = int(server._m_diff_chunks.value)
+    if CHUNK:
+        assert diff_chunks >= 2, (
+            "chunk-framed subscription negotiated but no chunk "
+            "messages shipped (§11.6)")
 
     # The failover left a postmortem with the version window.
     dumps = [f for f in os.listdir(flight_dir) if "cell_failover" in f]
@@ -213,7 +227,8 @@ def main(trace_path: str, flight_dir: str) -> int:
     print(f"multicell-smoke OK (codec {CODEC}): "
           f"{NREADERS} readers x {ROUNDS} reads "
           f"({total_reads} bitwise-checked), failovers={failovers}, "
-          f"evictions={evictions}, flight dumps={len(dumps)}, trace "
+          f"evictions={evictions}, diff chunks={diff_chunks}, "
+          f"flight dumps={len(dumps)}, trace "
           f"events={tr_report.get('events')}")
     return 0
 
