@@ -1,20 +1,33 @@
 """mtlint — framework-aware static analysis for mpit_tpu.
 
-Three rule families keep the invariants that used to live only in
-prose machine-checked on every tier-1 run:
+The rule families keep the invariants that used to live only in prose
+machine-checked on every tier-1 run:
 
 - **protocol** (MT-P1xx): PS wire-protocol conformance — tag pairing
-  across the client/server roles, ``*_ACK`` write tails, request/reply
-  deadlock shapes, and comm/native spec drift;
+  across the client/server roles, ``*_ACK`` write tails (one level of
+  helper calls followed interprocedurally), request/reply deadlock
+  shapes, and comm/native spec drift;
 - **concurrency** (MT-C2xx): lock-order inversions, blocking calls
   under a lock, and scheduler yields inside lock regions;
 - **jax** (MT-J3xx): host-device syncs and Python branches on traced
   values inside jitted functions, and update steps missing
-  ``donate_argnums``.
+  ``donate_argnums``;
+- **observability** (MT-O4xx): the mpit_tpu.obs contract;
+- **wire schema** (MT-S6xx): the declarative registry in
+  ``analysis/schema.py`` is the single source of truth for tags, INIT
+  versions, the flag lattice, and frame layouts — the six wire modules
+  and the negotiation code must conform, and the PROTOCOL.md §1/§6.0
+  tables are generated from it (``python -m mpit_tpu.analysis schema
+  --emit-docs [--check]``);
+- **model checking** (MT-M7xx): ``python -m mpit_tpu.analysis
+  modelcheck`` exhaustively explores the schema-declared handshake
+  state machines for deadlocks, unreachable acks, and unacked
+  terminals.
 
 Run ``python tools/mtlint.py mpit_tpu/`` (or the ``mtlint`` console
 entry).  The checked-in ``mtlint.toml`` baseline carries the vetted
-suppressions; see docs/ANALYSIS.md for the rule catalog.
+suppressions — keyed by line-content hashes, so unrelated line moves
+never force a re-pin; see docs/ANALYSIS.md for the rule catalog.
 """
 
 from mpit_tpu.analysis.config import Config, Suppression, discover_config, load_config

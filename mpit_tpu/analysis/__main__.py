@@ -1,0 +1,34 @@
+"""``python -m mpit_tpu.analysis`` — the analysis toolbox dispatcher.
+
+Subcommands:
+
+- (default / paths) — the mtlint linter (same as ``tools/mtlint.py``)
+- ``schema``      — wire-schema registry tooling: ``--emit-docs`` writes
+  the generated PROTOCOL.md §1/§6.0 tables, ``--check`` gates doc and
+  code drift (CI runs ``schema --emit-docs --check``)
+- ``modelcheck``  — bounded interleaving exploration of the schema's
+  handshake machines (``--report`` writes the explored-state JSON)
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "schema":
+        from mpit_tpu.analysis import schema
+
+        return schema.main(argv[1:])
+    if argv and argv[0] == "modelcheck":
+        from mpit_tpu.analysis import modelcheck
+
+        return modelcheck.main(argv[1:])
+    from mpit_tpu.analysis.cli import main as lint_main
+
+    return lint_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,10 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                     help="ignore any mtlint.toml (no baseline)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    ap.add_argument("--suggest-baseline", action="store_true",
+                    help="print ready-to-paste mtlint.toml entries (with "
+                    "line-move-tolerant content keys) for every "
+                    "unsuppressed finding")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="findings only, no summary")
     return ap.parse_args(argv)
@@ -74,15 +78,34 @@ def main(argv: Optional[List[str]] = None) -> int:
             s for s in config.suppressions if id(s) not in used]
 
     if args.as_json:
+        def as_dict(f):
+            d = dict(vars(f))
+            d["content"] = f.content
+            return d
+
         print(json.dumps({
-            "findings": [vars(f) for f in report.findings],
+            "findings": [as_dict(f) for f in report.findings],
             "suppressed": [
-                {"finding": vars(f), "reason": s.reason}
+                {"finding": as_dict(f), "reason": s.reason}
                 for f, s in report.suppressed
             ],
             "unused_suppressions": [s.render() for s in
                                     report.unused_suppressions],
         }, indent=2))
+        return report.exit_code
+
+    if args.suggest_baseline:
+        for f in report.findings:
+            print("[[suppress]]")
+            print(f'rule = "{f.rule}"')
+            print(f'file = "{f.path}"')
+            if f.content:
+                print(f'content = "{f.content}"  # {f.location}')
+            else:
+                print(f"line = {f.line}")
+            print('reason = "FIXME: justify or fix '
+                  f'({f.message[:60]}...)"')
+            print()
         return report.exit_code
 
     for f in report.findings:

@@ -34,8 +34,15 @@ from mpit_tpu.analysis.core import (
     SourceFile,
     callee_name,
     iter_functions,
+    register_rules,
     root_name,
 )
+
+register_rules({
+    "MT-C201": ("error", "lock-order inversion (A->B here, B->A elsewhere)"),
+    "MT-C202": ("warn", "blocking call while holding a lock"),
+    "MT-C203": ("error", "scheduler yield inside a lock region"),
+})
 
 _LOCK_NAME = re.compile(r"lock|mutex|cv|cond", re.IGNORECASE)
 

@@ -12,11 +12,20 @@ Baseline format::
     [[suppress]]
     rule = "MT-C202"            # required: exact rule id
     file = "mpit_tpu/comm/native/build.py"   # required: path suffix
-    line = 28                   # optional: exact line pin
+    content = "9f0b6a2c41de"    # preferred: content hash of the line
+    line = 28                   # legacy alternative: exact line pin
     reason = "the lock exists precisely to serialize the build"
 
 ``reason`` is mandatory and must be non-empty — a baseline entry that
 cannot say why it exists is a bug report, not a suppression.
+
+``content`` is the line-move-tolerant key: the first 12 hex chars of
+sha256 over the flagged line's stripped source text (printed by
+``mtlint --suggest-baseline`` and carried in ``--json`` output).  It
+survives unrelated edits above and below the site — the per-PR baseline
+re-pin churn that ``line =`` pins forced is exactly what it replaces.
+When both keys are present the content hash decides and the line is
+commentary.
 """
 
 from __future__ import annotations
@@ -106,6 +115,7 @@ class Suppression:
     file: str
     reason: str
     line: Optional[int] = None
+    content: Optional[str] = None  # line-move-tolerant content hash
     hits: int = 0  # incremented as findings match (unused-entry report)
 
     def matches(self, finding: Finding) -> bool:
@@ -113,12 +123,15 @@ class Suppression:
             return False
         if not finding.abspath.endswith(self.file):
             return False
+        if self.content is not None:
+            return finding.content == self.content
         if self.line is not None and finding.line != self.line:
             return False
         return True
 
     def render(self) -> str:
-        pin = f":{self.line}" if self.line is not None else ""
+        pin = f"#{self.content}" if self.content is not None else (
+            f":{self.line}" if self.line is not None else "")
         return f"{self.rule} @ {self.file}{pin} ({self.reason})"
 
 
@@ -144,10 +157,18 @@ def load_config(path: pathlib.Path) -> Config:
                 f"suppress entry {i} ({entry['rule']} @ {entry['file']}) "
                 "has an empty reason — justify it or fix the finding")
         line = entry.get("line")
+        content = entry.get("content")
+        if content is not None and not re.fullmatch(
+                r"[0-9a-f]{12}", str(content)):
+            raise ConfigError(
+                f"suppress entry {i} ({entry['rule']} @ {entry['file']}) "
+                f"has a malformed content key {content!r} — expected 12 "
+                "hex chars (see `mtlint --suggest-baseline`)")
         sups.append(Suppression(
             rule=str(entry["rule"]), file=str(entry["file"]),
             reason=str(entry["reason"]),
-            line=int(line) if line is not None else None))
+            line=int(line) if line is not None else None,
+            content=str(content) if content is not None else None))
     return Config(suppressions=sups, source=path)
 
 

@@ -11,6 +11,7 @@ see mpit_tpu.analysis.protocol).
 from __future__ import annotations
 
 import ast
+import hashlib
 import pathlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -20,32 +21,33 @@ WARN = "warn"
 INFO = "info"
 
 #: rule id -> (default severity, one-line description).  The id is the
-#: stable contract: baselines, tests and docs key on it.
+#: stable contract: baselines, tests and docs key on it.  Each rule
+#: family registers its own entries (``register_rules`` at module
+#: import) so the catalog lives next to the checker that owns it; the
+#: engine imports every family before any finding is created.
 RULES: Dict[str, Tuple[str, str]] = {
-    # -- protocol conformance (ps wire protocol, ps/tags.py) ---------------
-    "MT-P101": (WARN, "tag defined in the tag table but never used by any role"),
-    "MT-P102": (ERROR, "send/recv without a matching op in the peer role"),
-    "MT-P103": (ERROR, "write tag missing its *_ACK tail in the same function"),
-    "MT-P104": (ERROR, "request/reply cycle where both roles block on recv"),
-    "MT-P105": (ERROR, "comm/native specs drifted from the checked-in bindings"),
-    # -- bounded-wait discipline (the mpit_tpu.ft contract) ----------------
-    "MT-P201": (ERROR, "aio send/recv in a role file with no deadline=/abort= bound"),
-    "MT-P202": (ERROR, "blocking transport send/recv convenience in a role file"),
-    "MT-P203": (ERROR, "blocking socket call / sleep inside an event-loop callback (_el_*)"),
-    # -- concurrency (locks, threads, scheduler contract) ------------------
-    "MT-C201": (ERROR, "lock-order inversion (A->B here, B->A elsewhere)"),
-    "MT-C202": (WARN, "blocking call while holding a lock"),
-    "MT-C203": (ERROR, "scheduler yield inside a lock region"),
-    # -- JAX hot path ------------------------------------------------------
-    "MT-J301": (ERROR, "host-device sync inside a jitted function"),
-    "MT-J302": (WARN, "Python branch on a traced value inside a jitted function"),
-    "MT-J303": (INFO, "jitted update/step function without donate_argnums"),
-    # -- observability (the mpit_tpu.obs contract) -------------------------
-    "MT-O401": (WARN, "hand-rolled clock timing in a role file — use obs spans/registry"),
-    "MT-O402": (WARN, "print() reporting in a role file — use an obs snapshot or the logger"),
     # -- engine ------------------------------------------------------------
     "MT-X001": (ERROR, "file does not parse"),
 }
+
+
+def register_rules(rules: Dict[str, Tuple[str, str]]) -> None:
+    """Add one family's rules to the shared catalog (idempotent; a
+    conflicting re-registration is a programming error, caught loudly)."""
+    for rid, spec in rules.items():
+        if rid in RULES and RULES[rid] != spec:
+            raise ValueError(f"rule {rid} registered twice with different "
+                             f"specs: {RULES[rid]} vs {spec}")
+        RULES[rid] = spec
+
+
+def content_key(srcline: str) -> str:
+    """The line-move-tolerant baseline key for a finding's source line:
+    the first 12 hex chars of sha256 over the whitespace-stripped line.
+    Stable across unrelated edits above/below the suppressed site —
+    re-pinning a baseline because server.py grew a function is exactly
+    the churn this replaces."""
+    return hashlib.sha256(srcline.strip().encode("utf-8")).hexdigest()[:12]
 
 
 @dataclass
@@ -56,6 +58,7 @@ class Finding:
     message: str
     severity: str = ""
     abspath: str = ""  # posix absolute path (baseline matching form)
+    srcline: str = ""  # stripped source text of the flagged line
 
     def __post_init__(self) -> None:
         if not self.severity:
@@ -64,6 +67,12 @@ class Finding:
     @property
     def location(self) -> str:
         return f"{self.path}:{self.line}"
+
+    @property
+    def content(self) -> str:
+        """The content-hash suppression key (empty when the source line
+        is unknown — synthetic findings suppress by line instead)."""
+        return content_key(self.srcline) if self.srcline else ""
 
     def render(self) -> str:
         return f"{self.location}: {self.rule} [{self.severity}] {self.message}"
@@ -79,10 +88,15 @@ class SourceFile:
     text: str
     tree: ast.Module
 
+    def line_text(self, line: int) -> str:
+        lines = self.text.splitlines()
+        return lines[line - 1].strip() if 0 < line <= len(lines) else ""
+
     def finding(self, rule: str, node_or_line, message: str) -> Finding:
         line = getattr(node_or_line, "lineno", node_or_line)
         return Finding(rule, self.rel, int(line), message,
-                       abspath=self.path.as_posix())
+                       abspath=self.path.as_posix(),
+                       srcline=self.line_text(int(line)))
 
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}

@@ -6,7 +6,7 @@ import pathlib
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from mpit_tpu.analysis import concurrency, jaxrules, obsrules, protocol
+from mpit_tpu.analysis import concurrency, jaxrules, obsrules, protocol, schema
 from mpit_tpu.analysis.config import Config, Suppression
 from mpit_tpu.analysis.core import Finding, collect
 
@@ -36,6 +36,7 @@ def run(target, config: Optional[Config] = None) -> Report:
     findings += concurrency.check(files)
     findings += jaxrules.check(files)
     findings += obsrules.check(files)
+    findings += schema.check(files)
     findings.sort(key=Finding.sort_key)
 
     report = Report()

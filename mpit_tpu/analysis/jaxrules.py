@@ -52,8 +52,18 @@ from mpit_tpu.analysis.core import (
     SourceFile,
     callee_name,
     iter_functions,
+    register_rules,
     root_name,
 )
+
+register_rules({
+    "MT-J301": ("error", "host-device sync inside a jitted function"),
+    "MT-J302": ("warn", "Python branch on a traced value inside a jitted "
+                        "function"),
+    "MT-J303": ("info", "jitted update/step function without donate_argnums"),
+    "MT-J311": ("warn", "host materialization on a dplane hot path"),
+    "MT-J312": ("warn", "blocking device sync on a dplane hot path"),
+})
 
 _JIT_NAMES = {"jit", "pmap"}
 _NP_ROOTS = {"np", "numpy", "onp"}

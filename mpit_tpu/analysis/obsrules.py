@@ -43,7 +43,24 @@ import ast
 import pathlib
 from typing import List, Set, Tuple
 
-from mpit_tpu.analysis.core import Finding, SourceFile, callee_name, root_name
+from mpit_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    callee_name,
+    register_rules,
+    root_name,
+)
+
+register_rules({
+    "MT-O401": ("warn", "hand-rolled clock timing in a role file — use obs "
+                        "spans/registry"),
+    "MT-O402": ("warn", "print() reporting in a role file — use an obs "
+                        "snapshot or the logger"),
+    "MT-O403": ("warn", "undocumented mpit_* metric name (missing from "
+                        "docs/OBSERVABILITY.md)"),
+    "MT-O404": ("warn", "undocumented span phase (missing from the "
+                        "docs/OBSERVABILITY.md phase taxonomy)"),
+})
 
 _SCOPE_DIRS = {"ps", "ft", "comm"}
 _CLOCKS = {"time", "monotonic", "perf_counter"}

@@ -46,7 +46,7 @@ from __future__ import annotations
 import ast
 import importlib.util
 import pathlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from mpit_tpu.analysis.core import (
@@ -54,7 +54,29 @@ from mpit_tpu.analysis.core import (
     SourceFile,
     callee_name,
     iter_functions,
+    register_rules,
 )
+
+register_rules({
+    "MT-P101": ("warn", "tag defined in the tag table but never used by "
+                        "any role"),
+    "MT-P102": ("error", "send/recv without a matching op in the peer role"),
+    "MT-P103": ("error", "write tag missing its *_ACK tail in the same "
+                         "function (one helper level followed)"),
+    "MT-P104": ("error", "request/reply cycle where both roles block on "
+                         "recv"),
+    "MT-P105": ("error", "comm/native specs drifted from the checked-in "
+                         "bindings"),
+    "MT-P201": ("error", "aio send/recv in a role file with no "
+                         "deadline=/abort= bound"),
+    "MT-P202": ("error", "blocking transport send/recv convenience in a "
+                         "role file"),
+    "MT-P203": ("error", "blocking socket call / sleep inside an "
+                         "event-loop callback (_el_*)"),
+    "MT-P204": ("error", "disallowed call inside a SIGTERM handler"),
+    "MT-P501": ("warn", "tag has no TAG_PAIRS conformance entry"),
+    "MT-P502": ("warn", "tag missing from docs/PROTOCOL.md"),
+})
 
 #: callee name -> (op kind, index of the positional tag argument)
 _TAG_CALLS = {
@@ -71,21 +93,52 @@ class ProtoOp:
     kind: str  # "send" | "recv"
     tag: str  # tag-table name
     line: int
+    via: str = ""  # helper qualname when the op was inlined from a callee
+
+
+@dataclass
+class ParamTagOp:
+    """A send/recv whose tag is one of the enclosing function's
+    parameters — resolvable only at a call site (`_send_chunk_ack`'s
+    ``aio_send(..., tag, ...)`` shape)."""
+    kind: str
+    param: str
+    line: int
+
+
+@dataclass
+class HelperCall:
+    """One call site inside a role function (candidate helper edge)."""
+    name: str
+    node: ast.Call
+    line: int
 
 
 @dataclass
 class RoleFn:
-    """One function in a role file, with its tag ops in source order."""
+    """One function in a role file: its concrete tag ops in source
+    order, its parameter-tagged ops, and its call sites.  ``exp`` is the
+    interprocedural view — own ops plus ops inlined from one level of
+    same-role helper calls (tag parameters resolved per call site),
+    positioned at the call-site line."""
     role: str
     qual: str
     src: SourceFile
     ops: List[ProtoOp]
+    params: List[str] = field(default_factory=list)
+    param_ops: List[ParamTagOp] = field(default_factory=list)
+    calls: List[HelperCall] = field(default_factory=list)
+    exp: List[ProtoOp] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
 
     def sends(self, tag: str) -> List[ProtoOp]:
-        return [op for op in self.ops if op.kind == "send" and op.tag == tag]
+        return [op for op in self.exp if op.kind == "send" and op.tag == tag]
 
     def recvs(self, tag: str) -> List[ProtoOp]:
-        return [op for op in self.ops if op.kind == "recv" and op.tag == tag]
+        return [op for op in self.exp if op.kind == "recv" and op.tag == tag]
 
 
 def _load_tag_table(files: List[SourceFile]):
@@ -165,42 +218,56 @@ def _tag_of(node: ast.AST, table: Dict[str, int]) -> Optional[str]:
     return None
 
 
+def _fn_params(node: ast.AST) -> List[str]:
+    args = getattr(node, "args", None)
+    if args is None:
+        return []
+    return [a.arg for a in (args.posonlyargs + args.args)]
+
+
 def _collect_role_fns(files: List[SourceFile], table) -> List[RoleFn]:
+    """Every function in every role file — including op-less helpers
+    (they may carry parameter-tagged ops the expansion resolves) — with
+    the one-level interprocedural expansion applied."""
     fns: List[RoleFn] = []
     for src in files:
         role = _role_of(src)
         if role is None:
             continue
         for qual, node in iter_functions(src.tree):
-            ops = _extract_ops_shallow(node, table)
-            if ops:
-                fns.append(RoleFn(role=role, qual=qual, src=src, ops=ops))
-    return fns
+            fn = RoleFn(role=role, qual=qual, src=src, ops=[],
+                        params=_fn_params(node))
+            _extract_ops_shallow(fn, node, table)
+            fns.append(fn)
+    callers = _expand(fns, table)
+    return fns, callers
 
 
-def _extract_ops_shallow(fn: ast.AST, table) -> List[ProtoOp]:
-    """Like _extract_ops but without descending into nested defs —
-    a nested generator's ops belong to the nested function."""
-    ops: List[ProtoOp] = []
+def _extract_ops_shallow(fn: RoleFn, node: ast.AST, table) -> None:
+    """Populate ``fn``'s own ops, parameter-tagged ops and call sites,
+    without descending into nested defs — a nested generator's ops
+    belong to the nested function."""
 
-    def walk(node):
-        for child in ast.iter_child_nodes(node):
+    def walk(parent):
+        for child in ast.iter_child_nodes(parent):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
                                   ast.Lambda)):
                 continue
             if isinstance(child, ast.Call):
-                ops.extend(_extract_ops_call(child, table))
+                _extract_ops_call(fn, child, table)
             walk(child)
 
-    walk(fn)
-    ops.sort(key=lambda op: op.line)
-    return ops
+    walk(node)
+    fn.ops.sort(key=lambda op: op.line)
 
 
-def _extract_ops_call(node: ast.Call, table) -> List[ProtoOp]:
+def _extract_ops_call(fn: RoleFn, node: ast.Call, table) -> None:
     name = callee_name(node)
     if name not in _TAG_CALLS:
-        return []
+        if name:
+            fn.calls.append(HelperCall(name=name, node=node,
+                                       line=node.lineno))
+        return
     kind, tag_idx = _TAG_CALLS[name]
     tag_node: Optional[ast.AST] = None
     for kw in node.keywords:
@@ -208,10 +275,75 @@ def _extract_ops_call(node: ast.Call, table) -> List[ProtoOp]:
             tag_node = kw.value
     if tag_node is None and len(node.args) > tag_idx:
         tag_node = node.args[tag_idx]
-    tag = _tag_of(tag_node, table) if tag_node is not None else None
-    if tag is None:
-        return []
-    return [ProtoOp(kind=kind, tag=tag, line=node.lineno)]
+    if tag_node is None:
+        return
+    tag = _tag_of(tag_node, table)
+    if tag is not None:
+        fn.ops.append(ProtoOp(kind=kind, tag=tag, line=node.lineno))
+    elif isinstance(tag_node, ast.Name) and tag_node.id in fn.params:
+        fn.param_ops.append(ParamTagOp(kind=kind, param=tag_node.id,
+                                       line=node.lineno))
+
+
+def _bind_args(call: ast.Call, params: List[str]) -> dict:
+    """Map a helper's parameter names to the call-site argument nodes
+    (`self.helper(a, b)` binds past the bound `self`)."""
+    argmap: dict = {}
+    names = list(params)
+    if names and names[0] == "self" and isinstance(call.func, ast.Attribute):
+        names = names[1:]
+    for name, arg in zip(names, call.args):
+        argmap[name] = arg
+    for kw in call.keywords:
+        if kw.arg is not None:
+            argmap[kw.arg] = kw.value
+    return argmap
+
+
+def _expand(fns: List[RoleFn], table) -> "Dict[int, List[RoleFn]]":
+    """One level of interprocedural inlining: each function's ``exp``
+    op list gains the ops of every same-role helper it calls — concrete
+    tags verbatim, parameter tags resolved from the call-site arguments
+    — positioned at the call-site line.  Resolution prefers a helper in
+    the same file, then any role file of the same role (the §13
+    aggregation client rides ps/client.py's chunk-ack machinery).
+    Returns callee -> callers (by id) for the ack-discipline pass."""
+    by_file: Dict[Tuple[str, str], RoleFn] = {}
+    by_role: Dict[Tuple[str, str], List[RoleFn]] = {}
+    for f in fns:
+        by_file.setdefault((f.src.rel, f.name), f)
+        by_role.setdefault((f.role, f.name), []).append(f)
+
+    def resolve(name: str, caller: RoleFn) -> Optional[RoleFn]:
+        h = by_file.get((caller.src.rel, name))
+        if h is not None:
+            return h
+        cands = by_role.get((caller.role, name), [])
+        return cands[0] if cands else None
+
+    callers: Dict[int, List[RoleFn]] = {}
+    for f in fns:
+        exp = list(f.ops)
+        for hc in f.calls:
+            h = resolve(hc.name, f)
+            if h is None or h is f:
+                continue
+            if not (h.ops or h.param_ops):
+                continue
+            callers.setdefault(id(h), []).append(f)
+            argmap = _bind_args(hc.node, h.params)
+            for op in h.ops:
+                exp.append(ProtoOp(kind=op.kind, tag=op.tag, line=hc.line,
+                                   via=h.qual))
+            for pop in h.param_ops:
+                node = argmap.get(pop.param)
+                tag = _tag_of(node, table) if node is not None else None
+                if tag is not None:
+                    exp.append(ProtoOp(kind=pop.kind, tag=tag, line=hc.line,
+                                       via=h.qual))
+        exp.sort(key=lambda op: op.line)
+        f.exp = exp
+    return callers
 
 
 _PEER = {"client": "server", "server": "client"}
@@ -224,7 +356,7 @@ def _check_pairing(table, tag_lines, fns: List[RoleFn],
     by_role: Dict[str, List[RoleFn]] = {"client": [], "server": []}
     for fn in fns:
         by_role[fn.role].append(fn)
-        for op in fn.ops:
+        for op in fn.exp:
             used.add(op.tag)
 
     # MT-P101: tag in the table, never used by any role.  Tags whose
@@ -242,11 +374,11 @@ def _check_pairing(table, tag_lines, fns: List[RoleFn],
     # the peer role.  Reported once per (role, kind, tag) at first use.
     peer_ops: Dict[Tuple[str, str], set] = {}
     for fn in fns:
-        for op in fn.ops:
+        for op in fn.exp:
             peer_ops.setdefault((fn.role, op.kind), set()).add(op.tag)
     seen: set = set()
     for fn in fns:
-        for op in fn.ops:
+        for op in fn.exp:
             key = (fn.role, op.kind, op.tag)
             if key in seen or not _binary_pair(pairs.get(op.tag)):
                 continue
@@ -312,7 +444,17 @@ def _write_tags(table) -> Dict[str, str]:
             if not t.endswith("_ACK") and f"{t}_ACK" in table}
 
 
-def _check_ack_discipline(table, fns: List[RoleFn]) -> List[Finding]:
+def _check_ack_discipline(table, fns: List[RoleFn],
+                          callers: "Dict[int, List[RoleFn]]"
+                          ) -> List[Finding]:
+    """MT-P103, interprocedural: a write op's ack tail counts when it is
+    observed in the same function, in a helper the function calls (the
+    ``exp`` view — `_send_chunk_ack`, `_chunk_acks`), or — for an op
+    that itself lives in a helper — anywhere in a function that calls
+    the helper (`_forward_chunk`'s REDUCE posts are drained by
+    `_drain_parent_acks` in the `_reduce_round` loop).  One level each
+    way; the line-order requirement applies only within one body, where
+    source order is meaningful."""
     findings: List[Finding] = []
     writes = _write_tags(table)
     for fn in fns:
@@ -321,21 +463,32 @@ def _check_ack_discipline(table, fns: List[RoleFn]) -> List[Finding]:
                 continue
             ack = writes[op.tag]
             if fn.role == "client" and op.kind == "send":
-                # The writer must await the applied-ack before reusing
-                # the buffer / issuing dependent ops (0-byte tail).
-                if not any(a.line > op.line for a in fn.recvs(ack)):
-                    findings.append(fn.src.finding(
-                        "MT-P103", op.line,
-                        f"{fn.qual} sends write tag {op.tag} but never "
-                        f"receives its {ack} tail in the same function — "
-                        "the write completion is unobservable"))
+                want, verb, consequence = "recv", "receives", (
+                    "the write completion is unobservable")
             elif fn.role == "server" and op.kind == "recv":
-                if not any(a.line > op.line for a in fn.sends(ack)):
-                    findings.append(fn.src.finding(
-                        "MT-P103", op.line,
-                        f"{fn.qual} receives write tag {op.tag} but never "
-                        f"sends its {ack} tail in the same function — the "
-                        "peer's blocking wait for the ack will hang"))
+                want, verb, consequence = "send", "sends", (
+                    "the peer's blocking wait for the ack will hang")
+            else:
+                continue
+            # Own body + one inlined helper level, in source order.
+            if any(a.kind == want and a.tag == ack and a.line > op.line
+                   for a in fn.exp):
+                continue
+            # One caller level up: a same-role caller that observes the
+            # ack (any position — cross-function source order is not
+            # meaningful) vouches for the helper's naked op.
+            cs = callers.get(id(fn), [])
+            if cs and any(
+                    any(a.kind == want and a.tag == ack for a in c.exp)
+                    for c in cs):
+                continue
+            doing = ("sends write tag" if op.kind == "send"
+                     else "receives write tag")
+            findings.append(fn.src.finding(
+                "MT-P103", op.line,
+                f"{fn.qual} {doing} {op.tag} but never {verb} its {ack} "
+                "tail in the same function, a called helper, or a "
+                f"calling function — {consequence}"))
     return findings
 
 
@@ -345,9 +498,16 @@ def _check_deadlock_shape(fns: List[RoleFn]) -> List[Finding]:
     a request/reply wait cycle with no initiator."""
     findings: List[Finding] = []
     for f in fns:
-        peers = [g for g in fns if g.role == _PEER[f.role]]
+        if not f.exp:
+            continue
+        peers = [g for g in fns if g.role == _PEER[f.role] and g.exp]
+        # Anchor only on the function's OWN recvs: an inlined helper's
+        # internal send->recv order collapses onto one call-site line,
+        # which would fabricate "blocks before sending" shapes.  The
+        # expanded view still feeds prior_sends / the peer analysis, so
+        # helper-split request/reply pairs are followed.
         for r in (op for op in f.ops if op.kind == "recv"):
-            prior_sends = {op.tag for op in f.ops
+            prior_sends = {op.tag for op in f.exp
                            if op.kind == "send" and op.line < r.line}
             for g in peers:
                 t_sends = g.sends(r.tag)
@@ -358,7 +518,7 @@ def _check_deadlock_shape(fns: List[RoleFn]) -> List[Finding]:
                 # breaks the cycle).
                 required: Optional[set] = None
                 for s in t_sends:
-                    pre = {op.tag for op in g.ops
+                    pre = {op.tag for op in g.exp
                            if op.kind == "recv" and op.line < s.line}
                     required = pre if required is None else required & pre
                 if not required:
@@ -366,7 +526,7 @@ def _check_deadlock_shape(fns: List[RoleFn]) -> List[Finding]:
                 for u in sorted(required):
                     if u in prior_sends:
                         continue
-                    later_send = [op for op in f.ops if op.kind == "send"
+                    later_send = [op for op in f.exp if op.kind == "send"
                                   and op.tag == u and op.line > r.line]
                     if later_send:
                         findings.append(f.src.finding(
@@ -595,9 +755,9 @@ def check(files: List[SourceFile]) -> List[Finding]:
     table, tag_lines = _load_tag_table(files)
     if table:
         pairs = _load_tag_pairs(files)
-        fns = _collect_role_fns(files, table)
+        fns, callers = _collect_role_fns(files, table)
         findings += _check_pairing(table, tag_lines, fns, pairs)
-        findings += _check_ack_discipline(table, fns)
+        findings += _check_ack_discipline(table, fns, callers)
         findings += _check_deadlock_shape(fns)
         findings += _check_tag_registration(tag_lines, pairs, files)
     findings += _check_deadline_discipline(files)

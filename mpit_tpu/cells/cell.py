@@ -174,7 +174,7 @@ class ServingCell:
         self._head_fresh = time.monotonic()
         self._resyncing = False
         self._shedding = False
-        # Chunk-framed subscription (§11.6): with a chunk size in the
+        # Chunk-framed subscription (§11.8): with a chunk size in the
         # FT posture, FULL/DELTA frames arrive as chunk messages and
         # assemble here — one live assembly (the stream is FIFO), keyed
         # by (kind, from, to, count) so a dropped chunk surfaces as an
@@ -459,7 +459,7 @@ class ServingCell:
 
     def _assemble_chunk(self, got):
         """One chunked-subscription DIFF message into the live assembly
-        (§11.6).  Returns the completed (kind, from, to, head, body) or
+        (§11.8).  Returns the completed (kind, from, to, head, body) or
         None.  Duplicate chunks skip by index; a chunk of a *newer*
         frame abandons an incomplete older assembly (the chunked analog
         of a dropped whole frame — gap detection recovers); stragglers

@@ -100,7 +100,7 @@ def parse_diff(payload) -> Tuple[int, int, int, int, np.ndarray]:
     return kind, from_v, to_v, head, body
 
 
-#: chunked-subscription DIFF header (docs/PROTOCOL.md §11.6): int64
+#: chunked-subscription DIFF header (docs/PROTOCOL.md §11.8): int64
 #: [kind, from_version, to_version, head_version, nbytes, chunk_idx,
 #: chunk_count] — a FULL/DELTA body split into chunk_count independent
 #: messages so a 640 MB resync never head-of-line-blocks the stream.

@@ -100,7 +100,7 @@ FLAG_READONLY = 16
 #: never depends on the (possibly delayed) diff stream itself.
 FLAG_SUBSCRIBE = 32
 
-#: INIT v6 flags bit6: pipelined streaming transfers (docs/PROTOCOL.md
+#: INIT v3 flags bit6: pipelined streaming transfers (docs/PROTOCOL.md
 #: §12).  A GRAD / PARAM / PARAM_PUSH body ships as K independent chunk
 #: frames — each its own transport message with its own
 #: ``[epoch, seq, chunk_idx, chunk_count]`` header — so the three

@@ -640,14 +640,14 @@ class ParamServer:
         self._framed[crank] = bool(flags & FLAG_FRAMED)
         self._hb[crank] = bool(flags & FLAG_HEARTBEAT)
         # Pipelined streaming (§12): a framed posture — the writer path,
-        # plus chunk-framed diff streams for SUBSCRIBE cells (§11.6).
+        # plus chunk-framed diff streams for SUBSCRIBE cells (§11.8).
         if chunked:
             if ro and not sub:
                 raise ValueError(
                     f"rank {crank} announced FLAG_CHUNKED with the "
                     "READONLY posture — reads are served by the §8 "
                     "dispatcher; chunked streaming is the writer path "
-                    "(§12.1) or a chunk-framed subscription (§11.6)")
+                    "(§12.1) or a chunk-framed subscription (§11.8)")
             if not self._framed[crank]:
                 raise ValueError(
                     f"client {crank} announced FLAG_CHUNKED without "
@@ -1668,7 +1668,7 @@ class ParamServer:
         against the last version shipped to it when the history still
         holds that frame, else a FULL frame at the head — as ONE
         message, or as chunk messages when the subscription negotiated
-        FLAG_CHUNKED (§11.6: a 640 MB resync must not head-of-line-
+        FLAG_CHUNKED (§11.8: a 640 MB resync must not head-of-line-
         block the stream).  Either way the head frame comes out of (and
         is recorded into) the same snapshot cache wire reads share — N
         same-codec cells cost one encode and one XOR per committed

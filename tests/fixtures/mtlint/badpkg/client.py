@@ -28,3 +28,23 @@ def emit_rogue(transport, live, deadline):
     # (so MT-P101/P102 stay quiet) but is registered nowhere.
     yield from aio_send(transport, b"", 0, tags.ROGUE, live=live,
                         deadline=deadline)
+
+
+def _post_push(transport, frame, deadline):
+    # MT-P103 (interprocedural): a helper's naked PARAM_PUSH send whose
+    # only caller never observes the PARAM_PUSH_ACK tail — one level of
+    # call following must not excuse an ack nobody drains.
+    yield from aio_send(transport, frame, 0, tags.PARAM_PUSH,
+                        deadline=deadline)
+
+
+def push_params(transport, frames, deadline):
+    for frame in frames:
+        yield from _post_push(transport, frame, deadline)
+
+
+def finalize_push(transport, deadline):
+    # Pairs the ack channel for MT-P102 without vouching for _post_push
+    # (it never calls the helper).
+    yield from aio_recv(transport, 0, tags.PARAM_PUSH_ACK,
+                        deadline=deadline)

@@ -52,3 +52,17 @@ def trace_phases(span):
     # there and must stay silent.
     span.mark("good_phase")
     span.mark("rogue_phase")
+
+
+def _ack_push(transport, peer, live, gone):
+    yield from aio_send(transport, b"", peer, tags.PARAM_PUSH_ACK,
+                        live=live, abort=gone)
+
+
+def absorb_push(transport, buf, live, gone):
+    # Correct helper-split server write path: the ack rides _ack_push —
+    # the interprocedural scan must stay quiet here.
+    got = yield from aio_recv(transport, 1, tags.PARAM_PUSH, out=buf,
+                              live=live, abort=gone)
+    yield from _ack_push(transport, 1, live, gone)
+    return got

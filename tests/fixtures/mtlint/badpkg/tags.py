@@ -7,6 +7,8 @@ REQ = 4
 REPLY = 5
 ORPHAN = 6  # seeded MT-P101: defined, never used by any role
 ROGUE = 7  # seeded MT-P501/MT-P502: used by both roles, registered nowhere
+PARAM_PUSH = 8
+PARAM_PUSH_ACK = 9
 
 # Conformance pairing table (MT-P5xx): ROGUE is deliberately absent.
 TAG_PAIRS = {
@@ -16,4 +18,6 @@ TAG_PAIRS = {
     "REQ": ("client", "server"),
     "REPLY": ("server", "client"),
     "ORPHAN": ("client", "server"),
+    "PARAM_PUSH": ("client", "server"),
+    "PARAM_PUSH_ACK": ("server", "client"),
 }

@@ -16,3 +16,16 @@ def serve_param(transport, snapshot, live, gone):
     yield from aio_recv(transport, 1, tags.PARAM_REQ, live=live, abort=gone)
     yield from aio_send(transport, snapshot, 1, tags.PARAM, live=live,
                         abort=gone)
+
+
+def _send_ack_tail(transport, peer, tag, live, gone):
+    # Tag travels as a parameter (the _send_chunk_ack shape): resolved
+    # at the call site by the interprocedural scan.
+    yield from aio_send(transport, b"", peer, tag, live=live, abort=gone)
+
+
+def serve_grad_chunks(transport, buf, live, gone):
+    got = yield from aio_recv(transport, 1, tags.GRAD, out=buf, live=live,
+                              abort=gone)
+    yield from _send_ack_tail(transport, 1, tags.GRAD_ACK, live, gone)
+    return got

@@ -1,0 +1,95 @@
+"""Seeded schema-drift wire module (mtlint fixture — parsed, never
+imported).  Every deviation from analysis/schema.py's registry here is
+deliberate and pinned by tests/test_analysis.py."""
+
+import numpy as np
+
+HDR_BYTES = 24  # MT-S601: schema says 16 — pack/unpack widths diverge
+HDR_STALE_BYTES = 24
+FLAG_FRAMED = 1
+FLAG_HEARTBEAT = 2
+FLAG_STALENESS = 4
+FLAG_TIMING = 8
+FLAG_READONLY = 16
+FLAG_SUBSCRIBE = 32
+FLAG_CHUNKED = 64
+TIMING_TAIL_WORDS = 3
+TIMING_TAIL_BYTES = 8 * TIMING_TAIL_WORDS
+ACK_TIMING_WORDS = 5
+CHUNK_HDR_BYTES = 32
+CHUNK_ACK_WORDS = 3
+CHUNK_ACK_TIMING_WORDS = CHUNK_ACK_WORDS + TIMING_TAIL_WORDS
+CHUNK_REPLY_WORDS = 5
+CHUNK_BLOCK = 1024
+FLAG_ROGUE = 128  # MT-S601: not in the schema registry
+# MT-S601 (missing): HDR_STALE... actually the registry also wants every
+# declared constant present — init_v3 below drifts instead.
+
+
+def pack_header(buf, epoch, seq):
+    buf[:HDR_BYTES].view(np.int64)[:] = (epoch, seq)
+
+
+def unpack_header(buf):
+    hdr = buf[:HDR_BYTES].view(np.int64)
+    return int(hdr[0]), int(hdr[1])
+
+
+def header_frame(epoch, seq):
+    return np.asarray([epoch, seq], dtype=np.int64)
+
+
+def timed_frame(epoch, seq, t_us):
+    return np.asarray([epoch, seq, t_us], dtype=np.int64)
+
+
+def init_v3(offset, size, codec_id, epoch, flags, extra):
+    # MT-S602: six words where the schema layout says five — the v3
+    # announcement grew a field only one side knows about.
+    return np.asarray([offset, size, codec_id, epoch, flags, extra],
+                      dtype=np.int64)
+
+
+def init_v5(offset, size, codec_id, epoch, flags, chunk_elems):
+    return np.asarray([offset, size, codec_id, epoch, flags, chunk_elems],
+                      dtype=np.int64)
+
+
+def pack_reply_stamps(buf, base, t_tx, t_recv, t_ack):
+    buf[base:base + TIMING_TAIL_BYTES].view(np.int64)[:] = (
+        t_tx, t_recv, t_ack)
+
+
+def unpack_reply_stamps(buf, base):
+    tail = buf[base:base + TIMING_TAIL_BYTES].view(np.int64)
+    return int(tail[0]), int(tail[1]), int(tail[2])
+
+
+def pack_chunk_header(buf, epoch, seq, idx, count):
+    buf[:CHUNK_HDR_BYTES].view(np.int64)[:] = (epoch, seq, idx, count)
+
+
+def unpack_chunk_header(buf):
+    hdr = buf[:CHUNK_HDR_BYTES].view(np.int64)
+    return int(hdr[0]), int(hdr[1]), int(hdr[2]), int(hdr[3])
+
+
+def pack_chunk_reply(buf, epoch, seq, idx, count, version):
+    buf[:8 * CHUNK_REPLY_WORDS].view(np.int64)[:] = (
+        epoch, seq, idx, count, version)
+
+
+def unpack_chunk_reply(buf):
+    hdr = buf[:8 * CHUNK_REPLY_WORDS].view(np.int64)
+    return (int(hdr[0]), int(hdr[1]), int(hdr[2]), int(hdr[3]),
+            int(hdr[4]))
+
+
+def chunk_ack_frame(epoch, seq, idx):
+    return np.asarray([epoch, seq, idx], dtype=np.int64)
+
+
+def rogue_frame(a, b, c, d, e, f, g, h):
+    # MT-S602: an eight-word struct literal registered nowhere — a frame
+    # layout that bypassed the schema entirely.
+    return np.asarray([a, b, c, d, e, f, g, h], dtype=np.int64)

@@ -1,0 +1,31 @@
+"""Seeded MT-M701: the classic recv-recv wait cycle — the client blocks
+on the reply before sending the request, while the server sends the
+reply only after receiving the request.  Neither side can move from the
+initial state (mtlint fixture — plain machine data, never imported by
+the tree)."""
+
+MACHINES = [
+    {
+        "name": "seeded-recv-recv-deadlock",
+        "doc": "both roles wait on the other's send",
+        "channel_cap": 2,
+        "roles": {
+            "client": {
+                "start": "want",
+                "terminal": ["done"],
+                "transitions": [
+                    ("want", "recv", "REPLY", "server", "got", {}),
+                    ("got", "send", "REQ", "server", "done", {}),
+                ],
+            },
+            "server": {
+                "start": "serving",
+                "terminal": ["done"],
+                "transitions": [
+                    ("serving", "recv", "REQ", "client", "replying", {}),
+                    ("replying", "send", "REPLY", "client", "done", {}),
+                ],
+            },
+        },
+    },
+]

@@ -1,0 +1,29 @@
+"""Seeded MT-M703: the client's write declares an expected ack
+(``expects: W_ACK``) but the table lets both roles reach terminal rest
+without the ack ever being sent or received — the write completion is
+unobservable at quiescence (mtlint fixture — plain machine data)."""
+
+MACHINES = [
+    {
+        "name": "seeded-unacked-terminal",
+        "doc": "terminal rest with a declared ack still outstanding",
+        "channel_cap": 2,
+        "roles": {
+            "client": {
+                "start": "running",
+                "terminal": ["done"],
+                "transitions": [
+                    ("running", "send", "W", "server", "done",
+                     {"expects": "W_ACK"}),
+                ],
+            },
+            "server": {
+                "start": "serving",
+                "terminal": ["done"],
+                "transitions": [
+                    ("serving", "recv", "W", "client", "done", {}),
+                ],
+            },
+        },
+    },
+]

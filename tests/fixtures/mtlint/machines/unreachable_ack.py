@@ -1,0 +1,30 @@
+"""Seeded MT-M702: the client declares a recv for W_ACK, but the server
+table never sends it — the ack transition is dead protocol surface.  A
+tau escape keeps the machine deadlock-free so the unreachable-ack
+detector is what fires (mtlint fixture — plain machine data)."""
+
+MACHINES = [
+    {
+        "name": "seeded-unreachable-ack",
+        "doc": "declared ack recv that no execution can reach",
+        "channel_cap": 2,
+        "roles": {
+            "client": {
+                "start": "running",
+                "terminal": ["done"],
+                "transitions": [
+                    ("running", "send", "W", "server", "sent", {}),
+                    ("sent", "recv", "W_ACK", "server", "done", {}),
+                    ("sent", "tau", "give_up", "", "done", {}),
+                ],
+            },
+            "server": {
+                "start": "serving",
+                "terminal": ["done"],
+                "transitions": [
+                    ("serving", "recv", "W", "client", "done", {}),
+                ],
+            },
+        },
+    },
+]

@@ -47,11 +47,24 @@ class TestSeededViolations:
         assert hits[0].line == 9
 
     def test_missing_ack_write_path_detected(self, bad):
-        # Seed 2: push_grad ships GRAD without awaiting GRAD_ACK.
-        hits = bad.get("MT-P103", [])
-        assert len(hits) == 1
+        # Seed 2: push_grad ships GRAD without awaiting GRAD_ACK; seed 2b:
+        # _post_push is a helper whose naked PARAM_PUSH send no caller
+        # vouches for (the interprocedural scan must not excuse it).
+        hits = sorted(bad.get("MT-P103", []), key=lambda f: f.line)
+        assert len(hits) == 2
         assert (hits[0].path, hits[0].line) == ("client.py", 15)
         assert "GRAD" in hits[0].message and "GRAD_ACK" in hits[0].message
+        assert (hits[1].path, hits[1].line) == ("client.py", 37)
+        assert "PARAM_PUSH" in hits[1].message
+
+    def test_helper_split_acks_are_followed(self, bad):
+        # The §12/§13 helper-split shapes (cleanpkg stream_grads /
+        # serve_grad_chunks / badpkg absorb_push) must be SILENT: the
+        # scan follows one level of helper calls in both directions,
+        # resolving parameter-carried tags at the call site.
+        assert not [f for f in bad.get("MT-P103", [])
+                    if "absorb_push" in f.message
+                    or "_ack_push" in f.message]
 
     def test_lock_order_inversion_detected(self, bad):
         # Seed 3: a_then_b takes _lock->_cv, b_then_a takes _cv->_lock.
@@ -255,3 +268,275 @@ def test_cli_exit_codes():
         cwd=env_root, capture_output=True, text=True)
     assert bad.returncode == 1, bad.stdout + bad.stderr
     assert "MT-P103" in bad.stdout  # findings reach the console
+
+
+# -- wire-schema conformance (MT-S6xx) --------------------------------------
+
+
+class TestSchemaConformance:
+    DRIFTPKG = FIXTURES / "driftpkg"
+
+    @pytest.fixture(scope="class")
+    def drift(self):
+        return _by_rule(_findings(self.DRIFTPKG))
+
+    def test_live_tree_is_conformant(self):
+        from mpit_tpu.analysis import schema
+        from mpit_tpu.analysis.core import collect
+
+        files, errs = collect(REPO / "mpit_tpu")
+        assert errs == []
+        assert schema.check(files) == []
+
+    def test_constant_drift_detected(self, drift):
+        hits = drift.get("MT-S601", [])
+        locs = {(f.path, f.line) for f in hits}
+        assert ("ft/wire.py", 7) in locs  # HDR_BYTES = 24 vs schema 16
+        assert any("HDR_BYTES" in f.message and "16" in f.message
+                   for f in hits)
+        # FLAG_ROGUE: a constant the registry does not declare
+        assert any("FLAG_ROGUE" in f.message for f in hits)
+
+    def test_struct_width_drift_detected(self, drift):
+        hits = drift.get("MT-S602", [])
+        # init_v3 grew to six words; rogue_frame is registered nowhere
+        assert any("init_v3" in f.message and "6-word" in f.message
+                   for f in hits)
+        assert any("rogue_frame" in f.message for f in hits)
+
+    def test_tag_registry_drift_detected(self, drift):
+        msgs = [f.message for f in drift.get("MT-S603", [])]
+        assert any("REDUCE = 18" in m for m in msgs)
+        assert any("SIDEBAND" in m for m in msgs)
+        assert any("TAG_PAIRS['DIFF']" in m for m in msgs)
+
+    def test_clean_fixture_has_no_schema_findings(self):
+        by = _by_rule(_findings(CLEANPKG))
+        assert not any(r.startswith("MT-S6") for r in by)
+
+    def test_negotiation_lattice_extraction_matches_schema(self):
+        # The live _negotiate enforces exactly the declared REFUSALS —
+        # asserted through the engine: zero MT-S604/S605 on the tree
+        # (covered by test_live_tree_is_conformant) AND a doctored
+        # guard is caught.
+        import textwrap
+
+        from mpit_tpu.analysis import schema
+        from mpit_tpu.analysis.core import collect
+
+        src = (REPO / "mpit_tpu" / "ps" / "server.py").read_text()
+        # Drop the READONLY-requires-FRAMED guard: conformance must
+        # notice the declared rule is no longer enforced.
+        doctored = src.replace(
+            "if ro and not (flags & FLAG_FRAMED):", "if False:")
+        assert doctored != src
+        import pathlib
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            p = pathlib.Path(td) / "ps" / "server.py"
+            p.parent.mkdir()
+            p.write_text(doctored)
+            files, _ = collect(pathlib.Path(td))
+            findings = schema.check(files)
+        assert any(f.rule == "MT-S605" and "READONLY" in f.message
+                   and "FRAMED" in f.message for f in findings), [
+            f.render() for f in findings]
+
+
+class TestSchemaDocs:
+    def test_emit_docs_check_clean_on_tree(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "mpit_tpu.analysis", "schema",
+             "--emit-docs", "--check", "--root", str(REPO)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_check_nonzero_on_drift_fixture(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "mpit_tpu.analysis", "schema",
+             "--check", "--root",
+             str(FIXTURES / "driftpkg")],
+            capture_output=True, text=True)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "MT-S601" in r.stdout and "MT-S603" in r.stdout
+        assert "doc drift" in r.stdout
+
+    def test_generated_markers_present_in_protocol_md(self):
+        doc = (REPO / "docs" / "PROTOCOL.md").read_text()
+        for name in ("tag-table", "init-table", "flag-table"):
+            assert f"BEGIN GENERATED: mtlint-schema {name}" in doc
+            assert f"END GENERATED: mtlint-schema {name}" in doc
+
+    def test_doc_drift_detected_after_hand_edit(self, tmp_path):
+        from mpit_tpu.analysis import schema
+
+        root = tmp_path / "docs"
+        root.mkdir()
+        doc = root / "PROTOCOL.md"
+        src = (REPO / "docs" / "PROTOCOL.md").read_text()
+        doc.write_text(src.replace("| `GRAD` (2) |", "| `GRAD` (99) |"))
+        drift = schema.emit_docs(doc, check=True)
+        assert any("tag-table" in d for d in drift)
+        # and the clean copy is quiet
+        doc.write_text(src)
+        assert schema.emit_docs(doc, check=True) == []
+
+    def test_oracle_agrees_with_declared_lattice(self):
+        from mpit_tpu.analysis import schema
+
+        # requires edges refuse
+        for bits, missing in ((["SUBSCRIBE"], "READONLY"),
+                              (["READONLY"], "FRAMED")):
+            out = schema.negotiate(3, schema.flag_bits(*bits),
+                                   reader_rank=True, cell_rank=True)
+            assert not out.accepted and missing in out.reason
+        # negotiate-off is silent, not a refusal
+        out = schema.negotiate(3, schema.flag_bits("STALENESS"))
+        assert out.accepted and not out.staleness
+        out = schema.negotiate(
+            3, schema.flag_bits("FRAMED", "STALENESS", "TIMING"))
+        assert out.accepted and out.staleness and out.timing
+
+
+# -- bounded interleaving model checker (MT-M7xx) ---------------------------
+
+
+class TestModelCheck:
+    MACHINES = FIXTURES / "machines"
+
+    def test_live_handshakes_explore_clean(self):
+        from mpit_tpu.analysis import modelcheck
+
+        results = modelcheck.check_all()
+        assert {r.machine for r in results} == {
+            "init-grad-stop", "param-read", "retire", "preempt",
+            "subscribe"}
+        for r in results:
+            assert r.clean, [v.render() for v in r.violations]
+            assert r.states_fault_free > 0
+            assert not r.truncated
+
+    @pytest.mark.parametrize("fixture,rule", [
+        ("deadlock.py", "MT-M701"),
+        ("unreachable_ack.py", "MT-M702"),
+        ("unacked_terminal.py", "MT-M703"),
+    ])
+    def test_seeded_fixture_fires(self, fixture, rule):
+        from mpit_tpu.analysis import modelcheck
+
+        machines = modelcheck.load_machines_file(self.MACHINES / fixture)
+        results = modelcheck.check_all(machines)
+        rules = {v.rule for r in results for v in r.violations}
+        assert rule in rules, (fixture, rules)
+
+    def test_deadlock_trace_names_both_blocked_recvs(self):
+        from mpit_tpu.analysis import modelcheck
+
+        machines = modelcheck.load_machines_file(
+            self.MACHINES / "deadlock.py")
+        (res,) = modelcheck.check_all(machines)
+        (v,) = [v for v in res.violations if v.rule == "MT-M701"]
+        assert "blocked on recv(REPLY)" in v.detail
+        assert "blocked on recv(REQ)" in v.detail
+
+    def test_cli_exit_codes_and_report(self, tmp_path):
+        report = tmp_path / "mc.json"
+        ok = subprocess.run(
+            [sys.executable, "-m", "mpit_tpu.analysis", "modelcheck",
+             "--report", str(report)],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        import json
+
+        data = json.loads(report.read_text())
+        assert data["schema"] == "mpit_modelcheck/1"
+        assert data["clean"] is True
+        assert len(data["machines"]) == 5
+        assert data["total_states"] > 0
+        bad = subprocess.run(
+            [sys.executable, "-m", "mpit_tpu.analysis", "modelcheck",
+             "--machines",
+             str(self.MACHINES / "deadlock.py")],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert bad.returncode == 1
+        assert "MT-M701" in bad.stdout
+
+    def test_dup_toggle_widens_the_state_space(self):
+        from mpit_tpu.analysis import modelcheck
+
+        m = {r.machine: r for r in modelcheck.check_all()}
+        r = m["init-grad-stop"]
+        assert r.states_faulty > r.states_fault_free
+
+
+# -- content-hash suppression keys ------------------------------------------
+
+
+class TestContentHashBaseline:
+    def test_repo_baseline_is_content_keyed(self):
+        cfg = load_config(REPO / "mtlint.toml")
+        assert all(s.content for s in cfg.suppressions), [
+            s.render() for s in cfg.suppressions if not s.content]
+
+    def test_content_key_survives_line_moves(self, tmp_path):
+        from mpit_tpu.analysis.core import content_key
+
+        body = (
+            "import tags\n"
+            "from aio import aio_send\n\n\n"
+            "def push_grad(transport, grad):\n"
+            "    yield from aio_send(transport, grad, 0, tags.GRAD)\n")
+        tagmod = "GRAD = 1\nGRAD_ACK = 2\n" \
+                 "TAG_PAIRS = {'GRAD': ('client', 'server'), " \
+                 "'GRAD_ACK': ('server', 'client')}\n"
+        srv = ("import tags\nfrom aio import aio_recv, aio_send\n\n\n"
+               "def serve(transport, buf):\n"
+               "    yield from aio_recv(transport, 1, tags.GRAD, out=buf)\n"
+               "    yield from aio_send(transport, b'', 1, tags.GRAD_ACK)\n"
+               "    yield from aio_recv(transport, 1, tags.GRAD_ACK)\n")
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "tags.py").write_text(tagmod)
+        (pkg / "server.py").write_text(srv)
+        (pkg / "client.py").write_text(body)
+        flagged = ("yield from aio_send(transport, grad, 0, tags.GRAD)")
+        key = content_key(flagged)
+        (tmp_path / "mtlint.toml").write_text(
+            '[[suppress]]\nrule = "MT-P103"\nfile = "pkg/client.py"\n'
+            f'content = "{key}"\nreason = "test: content key"\n'
+            '[[suppress]]\nrule = "MT-P201"\nfile = "pkg/client.py"\n'
+            'line = 6\nreason = "test: line key for the same site"\n'
+            '[[suppress]]\nrule = "MT-P201"\nfile = "pkg/server.py"\n'
+            'reason = "test: file-wide for the server recv/sends"\n')
+        cfg = load_config(tmp_path / "mtlint.toml")
+        r1 = run(pkg, cfg)
+        assert not [f for f in r1.findings if f.rule == "MT-P103"], [
+            f.render() for f in r1.findings]
+        # Move the flagged line down 20 lines: the content entry still
+        # matches; the line-pinned MT-P201 entry goes stale.
+        (pkg / "client.py").write_text(
+            "import tags\nfrom aio import aio_send\n" + "\n" * 20 + body)
+        cfg = load_config(tmp_path / "mtlint.toml")
+        r2 = run(pkg, cfg)
+        assert not [f for f in r2.findings if f.rule == "MT-P103"]
+        assert [f for f in r2.findings if f.rule == "MT-P201"]
+        stale = [s for s in r2.unused_suppressions if s.line == 6]
+        assert stale, "line-pinned entry should have gone stale"
+
+    def test_malformed_content_key_rejected(self, tmp_path):
+        bad = tmp_path / "mtlint.toml"
+        bad.write_text('[[suppress]]\nrule = "MT-C202"\nfile = "x.py"\n'
+                       'content = "nothex"\nreason = "r"\n')
+        with pytest.raises(ConfigError, match="content"):
+            load_config(bad)
+
+    def test_suggest_baseline_prints_content_entries(self):
+        r = subprocess.run(
+            [sys.executable, "tools/mtlint.py",
+             "tests/fixtures/mtlint/badpkg", "--suggest-baseline",
+             "--no-config"],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert r.returncode == 1
+        assert "[[suppress]]" in r.stdout
+        assert 'content = "' in r.stdout

@@ -45,7 +45,7 @@ class TestDiffWire:
         assert cellwire.parse_diff(msg)[4].size == 0
 
     def test_chunked_pack_parse_roundtrip(self):
-        """§11.6: a frame's chunk-message sequence reassembles to the
+        """§11.8: a frame's chunk-message sequence reassembles to the
         exact body; a small body ships as one chunk message."""
         body = np.arange(100, dtype=np.uint8)
         msgs = cellwire.pack_diff_chunks(cellwire.DIFF_DELTA, 3, 5, 7,
@@ -402,7 +402,7 @@ class TestFabric:
             gang.close()
 
     def test_chunk_framed_subscription_bitwise(self):
-        """§11.6: a FLAG_CHUNKED subscription receives FULL/DELTA
+        """§11.8: a FLAG_CHUNKED subscription receives FULL/DELTA
         frames as chunk messages (SIZE=2048 f32 at a 4 KiB cut = 2
         chunks per frame) — reads stay bit-for-bit the upstream
         snapshot, and the server actually shipped chunk messages."""

@@ -23,7 +23,7 @@ expected vector — compressed subscriptions must stay bit-exact, not
 approximately right.  ``MPIT_SMOKE_CELL_CODEC=none`` keeps the fp32
 stream (the opt-out the launcher exposes as ``--cell_codec none``);
 ``MPIT_SMOKE_CELL_CHUNK`` (default 8192) chunk-frames the diff
-subscription (§11.6) and every read's bit-exactness check asserts the
+subscription (§11.8) and every read's bit-exactness check asserts the
 assembly — 0 opts back into whole-frame diffs.
 
 Usage: python tools/multicell_smoke.py <trace_out.json> [flight_dir]
@@ -52,7 +52,7 @@ NCELLS, NREADERS, ROUNDS, SIZE, MAX_LAG = 2, 8, 10, 16384, 4
 #: the fleet's subscription codec (int8 default — the launcher's
 #: --cell_codec default; 'none' = the opt-out)
 CODEC = os.environ.get("MPIT_SMOKE_CELL_CODEC", "int8")
-#: chunk-framed subscriptions (PROTOCOL.md §11.6): the cells announce
+#: chunk-framed subscriptions (PROTOCOL.md §11.8): the cells announce
 #: FLAG_CHUNKED at this cut so FULL/DELTA frames ship as chunk
 #: messages — bit-exactness of every read below asserts the assembly;
 #: 0 keeps the legacy whole-frame stream.
@@ -212,7 +212,7 @@ def main(trace_path: str, flight_dir: str) -> int:
     if CHUNK:
         assert diff_chunks >= 2, (
             "chunk-framed subscription negotiated but no chunk "
-            "messages shipped (§11.6)")
+            "messages shipped (§11.8)")
 
     # The failover left a postmortem with the version window.
     dumps = [f for f in os.listdir(flight_dir) if "cell_failover" in f]
