@@ -8,6 +8,10 @@ Subcommands:
   code drift (CI runs ``schema --emit-docs --check``)
 - ``modelcheck``  — bounded interleaving exploration of the schema's
   handshake machines (``--report`` writes the explored-state JSON)
+- ``disciplines`` — verify the declared concurrency/ownership
+  disciplines (atomic sections, single-writer sets, donation seams)
+  against the tree and gate on stale declarations (``--report`` writes
+  the mpit_disciplines/1 coverage JSON)
 """
 
 from __future__ import annotations
@@ -25,6 +29,10 @@ def main(argv=None) -> int:
         from mpit_tpu.analysis import modelcheck
 
         return modelcheck.main(argv[1:])
+    if argv and argv[0] == "disciplines":
+        from mpit_tpu.analysis import disciplines
+
+        return disciplines.main(argv[1:])
     from mpit_tpu.analysis.cli import main as lint_main
 
     return lint_main(argv)

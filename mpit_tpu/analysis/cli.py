@@ -95,12 +95,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         return report.exit_code
 
     if args.suggest_baseline:
+        # A suggested content key that equals an EXISTING entry's hash
+        # (same stripped line text flagged elsewhere, or a 48-bit
+        # collision) must not be emitted as another content entry: the
+        # loader would treat the two as one, and whichever matched
+        # first would silently swallow the other's findings.  Pin those
+        # by line instead, loudly.
+        existing = {}
+        for s in (config.suppressions if config else []):
+            if s.content:
+                existing.setdefault(s.content, s)
         for f in report.findings:
+            clash = existing.get(f.content) if f.content else None
             print("[[suppress]]")
             print(f'rule = "{f.rule}"')
             print(f'file = "{f.path}"')
-            if f.content:
+            if f.content and clash is None:
                 print(f'content = "{f.content}"  # {f.location}')
+            elif clash is not None:
+                print(f"# content key {f.content} already claimed by the "
+                      f"{clash.rule} entry for {clash.file} — a second "
+                      "content entry would silently merge with it; "
+                      "pinned by line instead")
+                print(f"line = {f.line}  # {f.location}")
             else:
                 print(f"line = {f.line}")
             print('reason = "FIXME: justify or fix '

@@ -4,7 +4,10 @@ yield contract.
 Lock regions are ``with <expr>:`` statements whose context expression
 *names* a lock (``lock`` / ``mutex`` / ``cv`` / ``cond`` in the source
 text — the naming convention of comm/tcp.py, comm/local.py and the
-native build serializer).  Within them:
+native build serializer).  The per-function lock/call/yield summaries
+come from the shared call graph (mpit_tpu.analysis.callgraph — one AST
+walk per function, shared with MT-P1xx/P203 and MT-Y8xx/D9xx), which
+also lets MT-C202 see *through* helpers:
 
 - **MT-C201** — the per-file lock-*order* graph (edges from every held
   lock to each newly acquired one, subscripts normalized so
@@ -13,30 +16,36 @@ native build serializer).  Within them:
   elsewhere in the same file is an inversion, flagged at both sites.
 - **MT-C202** — blocking calls (socket recv*/accept/connect/sendall,
   thread join, time.sleep, jax block_until_ready, subprocess run
-  helpers) must not run while a lock is held; ``Condition.wait``
-  releases its lock and is exempt by design.
+  helpers) must not run while a lock is held — whether the blocking
+  call is textually under the ``with`` or buried in a same-file helper
+  the lock region calls (resolved through the call graph).  Calls
+  guarded by a ``BlockingIOError``/``InterruptedError`` handler are the
+  nonblocking-socket convention and exempt; ``Condition.wait`` releases
+  its lock and is exempt by design.
 - **MT-C203** — a generator must never ``yield`` from inside a lock
   region: on the cooperative scheduler the task is parked mid-step
   *still holding the lock*, and any other task (or transport thread)
   that needs it deadlocks the role process.  Nested defs reset the
   held-set — their bodies run later, not under the enclosing lock.
+  (The interprocedural variant — a lock held across a *call* that
+  yields — is MT-Y803 in mpit_tpu.analysis.disciplines.)
 """
 
 from __future__ import annotations
 
 import ast
-import re
-from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from mpit_tpu.analysis.core import (
-    Finding,
-    SourceFile,
-    callee_name,
-    iter_functions,
-    register_rules,
-    root_name,
-)
+from mpit_tpu.analysis import callgraph
+from mpit_tpu.analysis.core import Finding, SourceFile, register_rules
+
+# Re-exported for compatibility: the lock/blocking model moved into the
+# shared call-graph layer when the one-walk-per-function summaries did.
+_lock_id = callgraph.lock_id
+_is_blocking = callgraph.is_blocking
+_LOCK_NAME = callgraph._LOCK_NAME
+_BLOCKING_ATTRS = callgraph.BLOCKING_ATTRS
+_SUBPROCESS_ATTRS = callgraph.SUBPROCESS_ATTRS
 
 register_rules({
     "MT-C201": ("error", "lock-order inversion (A->B here, B->A elsewhere)"),
@@ -44,131 +53,78 @@ register_rules({
     "MT-C203": ("error", "scheduler yield inside a lock region"),
 })
 
-_LOCK_NAME = re.compile(r"lock|mutex|cv|cond", re.IGNORECASE)
 
-#: attribute / name callees that block the calling thread outright.
-_BLOCKING_ATTRS = {
-    "recv", "recv_into", "recvfrom", "recvmsg", "accept", "connect",
-    "sendall", "sleep", "block_until_ready",
-}
-#: subprocess helpers — blocking only when called off the subprocess module.
-_SUBPROCESS_ATTRS = {"run", "call", "check_call", "check_output", "communicate"}
-
-
-def _lock_id(expr: ast.AST) -> Optional[str]:
-    """Normalized lock identity for a with-item, or None when the
-    expression does not look like a lock."""
-    try:
-        src = ast.unparse(expr)
-    except Exception:  # pragma: no cover - unparse is total on 3.10 asts
-        return None
-    if isinstance(expr, ast.Call):
-        # `with self._make_ctx():` — context factories (nullcontext,
-        # jax.default_device, ...) are not lock acquisitions even when
-        # their name happens to contain a lock-ish substring.
-        return None
-    if not _LOCK_NAME.search(src):
-        return None
-    # One lock *class* per container: self._out_cv[peer] == self._out_cv[dst].
-    return re.sub(r"\[[^\]]*\]", "[*]", src)
-
-
-def _is_blocking(call: ast.Call) -> bool:
-    name = callee_name(call)
-    if name == "join":
-        # Thread/process join blocks; str.join / os.path.join do not.
-        if isinstance(call.func, ast.Attribute):
-            recv = call.func.value
-            if isinstance(recv, (ast.Constant, ast.JoinedStr)):
-                return False
-            if root_name(call.func) in ("os", "posixpath", "ntpath", "str"):
-                return False
-        return True
-    if name in _BLOCKING_ATTRS:
-        return True
-    if name in _SUBPROCESS_ATTRS and root_name(call.func) == "subprocess":
-        return True
-    return False
-
-
-@dataclass
-class _Edge:
-    outer: str
-    inner: str
-    src: SourceFile
-    line: int
-    qual: str
-
-
-def _scan_function(src: SourceFile, qual: str, fn: ast.AST,
-                   edges: List[_Edge], findings: List[Finding]) -> None:
-    def visit(node: ast.AST, held: List[Tuple[str, int]]) -> None:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            return  # nested bodies run later, outside this region
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            acquired = []
-            for item in node.items:
-                lock = _lock_id(item.context_expr)
-                if lock is None:
-                    continue
-                for outer, _ in held + acquired:
-                    if outer != lock:
-                        edges.append(_Edge(
-                            outer=outer, inner=lock, src=src,
-                            line=node.lineno, qual=qual))
-                acquired.append((lock, node.lineno))
-            for sub in node.body:
-                visit(sub, held + acquired)
-            return
-        if held:
-            if isinstance(node, ast.Call) and _is_blocking(node):
-                lock, lline = held[-1]
-                findings.append(src.finding(
-                    "MT-C202", node,
-                    f"{qual} calls {ast.unparse(node.func)}() while holding "
-                    f"{lock} (acquired line {lline}) — the lock is pinned "
-                    "for the call's full blocking duration"))
-            if isinstance(node, (ast.Yield, ast.YieldFrom)):
-                lock, lline = held[-1]
-                findings.append(src.finding(
-                    "MT-C203", node,
-                    f"{qual} yields to the scheduler while holding {lock} "
-                    f"(acquired line {lline}) — the parked task wedges "
-                    "every other task that needs the lock"))
-        for child in ast.iter_child_nodes(node):
-            visit(child, held)
-
-    for child in ast.iter_child_nodes(fn):
-        visit(child, [])
-
-
-def check(files: List[SourceFile]) -> List[Finding]:
+def check(files: List[SourceFile],
+          graph: Optional[callgraph.CallGraph] = None) -> List[Finding]:
+    if graph is None:
+        graph = callgraph.build_graph(files)
     findings: List[Finding] = []
-    for src in files:
-        edges: List[_Edge] = []
-        for qual, fn in iter_functions(src.tree):
-            _scan_function(src, qual, fn, edges, findings)
-        # MT-C201 — pairwise inversions within one file (lock identities
-        # are only comparable inside a file: two classes may both name a
-        # lock ``self._lock`` without ever sharing it).
-        pairs: Dict[Tuple[str, str], List[_Edge]] = {}
-        for e in edges:
-            pairs.setdefault((e.outer, e.inner), []).append(e)
+
+    # MT-C202 / MT-C203 — straight off the per-function summaries.
+    for fn in graph.functions:
+        for cs in fn.calls:
+            if cs.lock is None or cs.guarded:
+                continue
+            lock, lline = cs.lock
+            if callgraph.is_blocking(cs.node):
+                findings.append(fn.src.finding(
+                    "MT-C202", cs.node,
+                    f"{fn.qual} calls {ast.unparse(cs.node.func)}() while "
+                    f"holding {lock} (acquired line {lline}) — the lock is "
+                    "pinned for the call's full blocking duration"))
+                continue
+            # Interprocedural: the blocking call is one-to-N helper
+            # levels down (same-file resolution, _nb_*/guarded exempt).
+            for target in graph.resolve(fn, cs):
+                if target.name.startswith("_nb_"):
+                    continue
+                witness = graph.may_block(target)
+                if witness is not None:
+                    findings.append(fn.src.finding(
+                        "MT-C202", cs.node,
+                        f"{fn.qual} calls {ast.unparse(cs.node.func)}() "
+                        f"while holding {lock} (acquired line {lline}) and "
+                        f"the callee blocks: {witness} — the lock is pinned "
+                        "for the call's full blocking duration"))
+                    break
+        for ys in fn.yields:
+            if ys.lock is None:
+                continue
+            if isinstance(ys.node, (ast.Yield, ast.YieldFrom)):
+                lock, lline = ys.lock
+                findings.append(fn.src.finding(
+                    "MT-C203", ys.node,
+                    f"{fn.qual} yields to the scheduler while holding "
+                    f"{lock} (acquired line {lline}) — the parked task "
+                    "wedges every other task that needs the lock"))
+
+    # MT-C201 — pairwise inversions within one file (lock identities
+    # are only comparable inside a file: two classes may both name a
+    # lock ``self._lock`` without ever sharing it).
+    by_file: Dict[str, List[Tuple[str, str, int, callgraph.FnInfo]]] = {}
+    for fn in graph.functions:
+        for outer, inner, line in fn.lock_edges:
+            by_file.setdefault(fn.src.rel, []).append(
+                (outer, inner, line, fn))
+    for rel, edges in by_file.items():
+        pairs: Dict[Tuple[str, str],
+                    List[Tuple[int, callgraph.FnInfo]]] = {}
+        for outer, inner, line, fn in edges:
+            pairs.setdefault((outer, inner), []).append((line, fn))
         reported = set()
         for (a, b), sites in sorted(pairs.items()):
             if (b, a) not in pairs or a == b:
                 continue
-            for e in sites:
-                key = (a, b, e.line)
+            for line, fn in sites:
+                key = (a, b, line)
                 if key in reported:
                     continue
                 reported.add(key)
-                other = pairs[(b, a)][0]
-                findings.append(src.finding(
-                    "MT-C201", e.line,
-                    f"{e.qual} acquires {b} while holding {a}, but "
-                    f"{other.qual} (line {other.line}) acquires {a} while "
+                oline, ofn = pairs[(b, a)][0]
+                findings.append(fn.src.finding(
+                    "MT-C201", line,
+                    f"{fn.qual} acquires {b} while holding {a}, but "
+                    f"{ofn.qual} (line {oline}) acquires {a} while "
                     f"holding {b} — two threads taking the locks in "
                     "opposite order deadlock"))
     return findings

@@ -6,7 +6,8 @@ import pathlib
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from mpit_tpu.analysis import concurrency, jaxrules, obsrules, protocol, schema
+from mpit_tpu.analysis import (callgraph, concurrency, disciplines, jaxrules,
+                               obsrules, ownership, protocol, schema)
 from mpit_tpu.analysis.config import Config, Suppression
 from mpit_tpu.analysis.core import Finding, collect
 
@@ -31,12 +32,17 @@ def run(target, config: Optional[Config] = None) -> Report:
     """Lint one file or directory tree.  ``config`` carries the baseline;
     suppression accounting (``unused_suppressions``) is per-run."""
     files, findings = collect(pathlib.Path(target))
+    # ONE interprocedural summary pass (and one parsed AST, held by the
+    # SourceFile) shared by every family that looks through calls.
+    graph = callgraph.build_graph(files)
     findings = list(findings)
-    findings += protocol.check(files)
-    findings += concurrency.check(files)
+    findings += protocol.check(files, graph)
+    findings += concurrency.check(files, graph)
     findings += jaxrules.check(files)
     findings += obsrules.check(files)
     findings += schema.check(files)
+    findings += disciplines.check(files, graph)
+    findings += ownership.check(files, graph)
     findings.sort(key=Finding.sort_key)
 
     report = Report()

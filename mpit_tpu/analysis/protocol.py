@@ -49,6 +49,7 @@ import pathlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from mpit_tpu.analysis import callgraph
 from mpit_tpu.analysis.core import (
     Finding,
     SourceFile,
@@ -585,33 +586,45 @@ _EL_BLOCKING = {
 }
 
 
-def _check_event_loop_discipline(files: List[SourceFile]) -> List[Finding]:
+def _check_event_loop_discipline(files: List[SourceFile],
+                                 graph: "callgraph.CallGraph"
+                                 ) -> List[Finding]:
     """MT-P203: an event-loop transport multiplexes every peer on one
     thread, so its selector-dispatch callbacks (the ``_el_*`` naming
     convention, comm/tcp.py) may only touch sockets through guarded
     nonblocking helpers (``_nb_*``).  A raw ``recv``/``send``/``accept``
-    — or worse, ``sendall``/``time.sleep``/``settimeout`` — inside a
-    callback turns one slow peer into a stall of the whole rank's I/O.
-    Checked everywhere the convention appears; helpers (non-``_el_``
-    functions) are exempt by design — that is where the guarded raw
-    calls live."""
+    — or worse, ``sendall``/``time.sleep``/``settimeout`` — turns one
+    slow peer into a stall of the whole rank's I/O.  Checked
+    interprocedurally over the shared call graph: a blocking call
+    buried N same-file helpers below the callback is the same stall.
+    ``_nb_*`` helpers and ``BlockingIOError``-guarded calls are the
+    declared nonblocking seam and exempt; calls to generator functions
+    only build the generator and are not descended into."""
     findings: List[Finding] = []
-    for src in files:
-        for qual, fn in iter_functions(src.tree):
-            name = qual.rsplit(".", 1)[-1]
-            if not name.startswith("_el_"):
+    seen = set()
+    for fn in graph.functions:
+        if not fn.name.startswith("_el_"):
+            continue
+        for owner, cs, path in graph.reach_calls(fn):
+            if cs.guarded or cs.callee not in _EL_BLOCKING:
                 continue
-            for node in _walk_el(fn):
-                if not isinstance(node, ast.Call):
-                    continue
-                callee = callee_name(node)
-                if callee in _EL_BLOCKING:
-                    findings.append(src.finding(
-                        "MT-P203", node.lineno,
-                        f"{qual} calls {callee}() inside an event-loop "
-                        "callback — one blocked peer stalls every peer's "
-                        "I/O; route socket work through the _nb_* "
-                        "nonblocking helpers"))
+            key = (owner.src.rel, cs.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            if owner is fn:
+                message = (
+                    f"{fn.qual} calls {cs.callee}() inside an event-loop "
+                    "callback — one blocked peer stalls every peer's "
+                    "I/O; route socket work through the _nb_* "
+                    "nonblocking helpers")
+            else:
+                message = (
+                    f"{owner.qual} calls {cs.callee}() and runs inside "
+                    f"the event-loop callback {fn.qual} ({path}) — one "
+                    "blocked peer stalls every peer's I/O; route socket "
+                    "work through the _nb_* nonblocking helpers")
+            findings.append(owner.src.finding("MT-P203", cs.line, message))
     return findings
 
 
@@ -750,7 +763,10 @@ def _rel_sibling(src: SourceFile, sibling: pathlib.Path) -> str:
     return (base / sibling.name).as_posix()
 
 
-def check(files: List[SourceFile]) -> List[Finding]:
+def check(files: List[SourceFile],
+          graph: "Optional[callgraph.CallGraph]" = None) -> List[Finding]:
+    if graph is None:
+        graph = callgraph.build_graph(files)
     findings: List[Finding] = []
     table, tag_lines = _load_tag_table(files)
     if table:
@@ -761,7 +777,7 @@ def check(files: List[SourceFile]) -> List[Finding]:
         findings += _check_deadlock_shape(fns)
         findings += _check_tag_registration(tag_lines, pairs, files)
     findings += _check_deadline_discipline(files)
-    findings += _check_event_loop_discipline(files)
+    findings += _check_event_loop_discipline(files, graph)
     findings += _check_signal_handler_discipline(files)
     findings += _check_spec_drift(files)
     return findings

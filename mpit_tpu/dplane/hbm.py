@@ -290,7 +290,9 @@ class HbmSlot:
         version.  Rule state is deliberately kept — the reference's
         seed overwrites params only.  The placed array is re-owned on
         device (:func:`device_copy`) — a numpy-aliased param entering
-        this slot's donated applies would corrupt the heap."""
+        this slot's donated applies would corrupt the heap.  The
+        place_flat -> device_copy pairing is a declared owned path
+        (`hbm-seed-owned`, MT-D903): dropping the wrapper fails lint."""
         self.param = device_copy(place_flat(value, self.config))
         self._invalidate()
 
@@ -298,7 +300,10 @@ class HbmSlot:
 
     def snapshot_host(self) -> np.ndarray:
         """This version's device->host copy, cached: N wire reads of one
-        committed version cost one d2h however many clients ask."""
+        committed version cost one d2h however many clients ask.
+        `param` is a donated slot (`hbm-snapshot-materialize`,
+        MT-D902): the cache must hold the np.asarray materialization,
+        never a bare alias the next donated apply would delete."""
         if self._snap_host is None or self._snap_host[0] != self.version:
             self._snap_host = (self.version, np.asarray(self.param))
             self._m_copies.inc()

@@ -1958,6 +1958,10 @@ class ParamServer:
         # installed version trails the head beyond max_lag (BUSY with a
         # catch-up hint), which is what makes the staleness bound
         # *enforced* rather than advisory.
+        # Declared atomic section `ps-read-gate-window` (MT-Y801): no
+        # scheduler yield between this gate and the stamped reply header
+        # — the (version, head) bound in the OK header is only exact
+        # because nothing can park the task inside this window.
         gate = self._read_gate()
         if gate is not None:
             status, word = gate

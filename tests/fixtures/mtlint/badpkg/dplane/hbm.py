@@ -1,0 +1,20 @@
+"""Seeded donation-seam violations under dplane/ (mtlint fixture —
+parsed, never imported).  The rel-path suffix ``dplane/hbm.py`` makes
+the hbm-seed-owned and hbm-snapshot-materialize disciplines apply."""
+
+
+class HbmSlot:
+    def __init__(self, n, config):
+        self.config = config
+        self.version = 0
+
+    def seed(self, value):
+        # MT-D903: place_flat aliases host memory; the declared owned
+        # path wraps it in device_copy before it can be donated.
+        self.param = place_flat(value, self.config)
+
+    def snapshot_host(self):
+        # MT-D902: caches the bare donated buffer instead of
+        # materializing it — the next apply donates it away.
+        self._snap = (self.version, self.param)
+        return self._snap[1]

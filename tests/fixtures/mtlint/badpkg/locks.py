@@ -29,3 +29,19 @@ class Box:
     def pump(self):
         with self._lock:
             yield EXEC  # MT-C203: parked by the scheduler lock-in-hand
+
+    def nap_via_sched(self):
+        # Plain function that re-enters the cooperative scheduler; fine
+        # on its own, poison when called with a native lock held.
+        self.sched.wait()
+
+    def hold_and_greet(self):
+        with self._lock:
+            self.nap_via_sched()  # MT-Y803: yields via helper, lock held
+
+    def slow_flush(self):
+        time.sleep(0.1)
+
+    def hold_and_flush(self):
+        with self._lock:
+            self.slow_flush()  # MT-C202: blocks one helper down

@@ -1,0 +1,36 @@
+"""Seeded yield-atomicity + ownership violations (mtlint fixture —
+parsed, never imported).  The rel-path suffix ``ps/server.py`` makes
+the declared disciplines in mpit_tpu.analysis.disciplines apply here:
+the read-gate window, the device-plane single-writer set and the
+chunk-apply donation seam."""
+
+import numpy as np
+
+EXEC = "EXEC"
+
+
+class PS:
+    def _read_gate(self):
+        if self.lag > self.bound:
+            return None
+        return self.version
+
+    def _dispatch_read(self, req):
+        gate = self._read_gate()
+        # MT-Y801: scheduler yield inside the declared read-gate window.
+        yield EXEC
+        self.serve(gate, req)
+
+    def steal_ticket(self):
+        # MT-Y802: pops the device plane outside the declared writer set.
+        return self._plane.pop()
+
+    def bad_apply(self, codec, blob, lo):
+        # MT-D901: a frombuffer view of the receive ring reaches the
+        # donated chunk apply.
+        self._hbm.apply_wire_chunk(codec, np.frombuffer(blob, np.float32), lo)
+
+    def lazy_apply(self, codec, grad, lo):
+        # MT-D903: ownership of a bare parameter cannot be proven at
+        # the declared seam.
+        self._hbm.apply_wire_chunk(codec, grad, lo)

@@ -14,3 +14,11 @@ class BadLoop:
     def _el_on_writable(self, conn, payload):
         # MT-P203: sendall blocks the whole loop on one peer's backpressure.
         conn.sock.sendall(payload)
+
+    def _pump_once(self, conn):
+        # Not an _el_* callback itself — the local scan never saw this.
+        # MT-P203 (interprocedural): raw recv one helper below _el_on_timer.
+        conn.sock.recv(64)
+
+    def _el_on_timer(self):
+        self._pump_once(self._conn)

@@ -1,0 +1,24 @@
+"""Clean donation-seam twins under dplane/ (mtlint fixture — zero
+findings): host values are copied onto device before entering the
+donated apply chain, and slot readers materialize or replicate before
+the next apply donates the buffer."""
+
+import numpy as np
+
+
+class HbmSlot:
+    def __init__(self, n, config):
+        self.config = config
+        self.version = 0
+        self.param = device_copy(
+            place_flat(np.zeros((n,), np.float32), config))
+
+    def seed(self, value):
+        self.param = device_copy(place_flat(value, self.config))
+
+    def snapshot_host(self):
+        self._snap = (self.version, np.asarray(self.param))
+        return self._snap[1]
+
+    def pull_device(self):
+        return self._replicate(self.param)

@@ -25,6 +25,14 @@ class Pool:
     def idle(self):
         time.sleep(0.01)  # blocking, but no lock held
 
+    def slow_flush(self):
+        time.sleep(0.01)  # blocking, but callers only reach it lock-free
+
+    def flush_outside(self):
+        with self._lock:
+            self.jobs.clear()
+        self.slow_flush()  # helper blocks, lock already released
+
 
 def update(w, g):
     return w - 0.1 * g

@@ -104,12 +104,76 @@ class TestSeededViolations:
 
     def test_event_loop_blocking_detected(self, bad):
         # MT-P203: raw recv + time.sleep + sendall inside _el_* callbacks
-        # (tcp.py fixture); the cleanpkg _nb_*-helper shape must be silent
-        # (asserted by test_clean_fixture_is_silent).
+        # (tcp.py fixture) PLUS the interprocedural seed: a raw recv one
+        # helper below _el_on_timer, flagged at the blocking site inside
+        # the helper.  The cleanpkg _nb_*-helper shapes (one and two
+        # levels deep) must be silent (test_clean_fixture_is_silent).
         hits = bad.get("MT-P203", [])
         assert {(f.path, f.line) for f in hits} == {
-            ("tcp.py", 9), ("tcp.py", 11), ("tcp.py", 16)}
+            ("tcp.py", 9), ("tcp.py", 11), ("tcp.py", 16), ("tcp.py", 21)}
         assert all("event-loop callback" in f.message for f in hits)
+
+    def test_event_loop_blocking_through_helper_names_the_path(self, bad):
+        # The interprocedural finding must name both the helper that
+        # blocks and the callback that reaches it — exactly once.
+        hits = [f for f in bad.get("MT-P203", []) if f.line == 21]
+        assert len(hits) == 1
+        assert "_pump_once" in hits[0].message
+        assert "_el_on_timer" in hits[0].message
+
+    def test_interprocedural_blocking_under_lock_detected(self, bad):
+        # MT-C202 via the call graph: hold_and_flush blocks one helper
+        # down (slow_flush -> time.sleep) — exactly one finding, at the
+        # call site under the lock.
+        hits = [f for f in bad.get("MT-C202", [])
+                if (f.path, f.line) == ("locks.py", 47)]
+        assert len(hits) == 1
+        assert "slow_flush" in hits[0].message
+
+    def test_lock_across_scheduler_yield_detected(self, bad):
+        # MT-Y803: hold_and_greet holds _lock across nap_via_sched(),
+        # which re-enters the scheduler — exactly one finding.
+        hits = bad.get("MT-Y803", [])
+        assert [(f.path, f.line) for f in hits] == [("locks.py", 40)]
+        assert "nap_via_sched" in hits[0].message
+
+    def test_atomic_section_yield_detected(self, bad):
+        # MT-Y801: a yield inside the declared read-gate window of the
+        # fixture ps/server.py — exactly one finding.
+        hits = bad.get("MT-Y801", [])
+        assert [(f.path, f.line) for f in hits] == [("ps/server.py", 21)]
+        assert "ps-read-gate-window" in hits[0].message
+
+    def test_single_writer_escape_detected(self, bad):
+        # MT-Y802: steal_ticket pops the device plane outside the
+        # declared writer set — exactly one finding.  The cleanpkg twin
+        # pops one helper BELOW the declared writer and must stay
+        # silent (test_clean_fixture_is_silent).
+        hits = bad.get("MT-Y802", [])
+        assert [(f.path, f.line) for f in hits] == [("ps/server.py", 26)]
+        assert "dplane-single-writer" in hits[0].message
+
+    def test_unowned_buffer_at_seam_detected(self, bad):
+        # MT-D901: a frombuffer view reaches the donated chunk apply —
+        # exactly one finding.
+        hits = bad.get("MT-D901", [])
+        assert [(f.path, f.line) for f in hits] == [("ps/server.py", 31)]
+        assert "frombuffer" in hits[0].message
+
+    def test_ownership_wrapper_dropped_detected(self, bad):
+        # MT-D903, both shapes: an unprovable sink argument
+        # (ps/server.py) and a declared owned path whose device_copy
+        # wrapper is gone (dplane/hbm.py) — exactly one finding each.
+        hits = bad.get("MT-D903", [])
+        assert {(f.path, f.line) for f in hits} == {
+            ("ps/server.py", 36), ("dplane/hbm.py", 14)}
+
+    def test_donated_slot_leak_detected(self, bad):
+        # MT-D902: snapshot_host caches the bare donated buffer —
+        # exactly one finding.
+        hits = bad.get("MT-D902", [])
+        assert [(f.path, f.line) for f in hits] == [("dplane/hbm.py", 19)]
+        assert "self.param" in hits[0].message
 
     def test_signal_handler_blocking_detected(self, bad):
         # MT-P204: every call in the seeded SIGTERM handler (lock,
@@ -470,6 +534,135 @@ class TestModelCheck:
         assert r.states_faulty > r.states_fault_free
 
 
+# -- declared concurrency/ownership disciplines (MT-Y8xx / MT-D9xx) ---------
+
+
+class TestDisciplines:
+    def test_real_tree_disciplines_all_verified(self):
+        # The acceptance gate: every declared discipline matches live
+        # code sites (no stale declarations) and verifies clean.
+        from mpit_tpu.analysis import disciplines
+
+        rep = disciplines.coverage_report(REPO / "mpit_tpu")
+        assert rep["schema"] == "mpit_disciplines/1"
+        assert rep["stale"] == 0, [
+            r["name"] for r in rep["disciplines"] if r["status"] == "stale"]
+        assert rep["violated"] == 0, [
+            r for r in rep["disciplines"] if r["status"] == "violated"]
+        assert rep["verified"] >= 6
+        # The minimum coverage the spec names: the §11 read-gate window,
+        # one single-writer per plane, and the donation seam.
+        names = {r["name"] for r in rep["disciplines"]}
+        assert {"ps-read-gate-window", "dplane-single-writer",
+                "aggplane-single-writer", "reader-single-writer",
+                "cell-stream-single-writer",
+                "chunk-apply-owned-seam"} <= names
+
+    def test_cli_report_and_exit_codes(self, tmp_path):
+        report = tmp_path / "disc.json"
+        ok = subprocess.run(
+            [sys.executable, "-m", "mpit_tpu.analysis", "disciplines",
+             "--report", str(report)],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        import json
+
+        data = json.loads(report.read_text())
+        assert data["schema"] == "mpit_disciplines/1"
+        assert data["verified"] >= 6 and data["stale"] == 0
+        assert all(r["status"] == "verified" for r in data["disciplines"])
+
+    def test_stale_declaration_gate(self, tmp_path):
+        # A tree with none of the declared files: every row is stale and
+        # the CLI fails — a registry that matches nothing is drift, the
+        # same spirit as a stale baseline entry.
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "other.py").write_text("def f():\n    return 1\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "mpit_tpu.analysis", "disciplines",
+             "--root", str(pkg)],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "stale" in r.stdout
+
+    # -- mutation proofs: breaking a guarded site turns the tree red --------
+
+    def _doctored(self, tmp_path, rel, old, new):
+        import pathlib as _p
+
+        src = (REPO / "mpit_tpu" / rel).read_text()
+        assert old in src
+        doctored = src.replace(old, new)
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(doctored)
+        from mpit_tpu.analysis.core import collect
+
+        files, errs = collect(_p.Path(tmp_path))
+        assert errs == []
+        return files
+
+    def test_yield_in_read_gate_window_turns_tree_red(self, tmp_path):
+        from mpit_tpu.analysis import disciplines
+
+        files = self._doctored(
+            tmp_path, "ps/server.py",
+            "gate = self._read_gate()",
+            "gate = self._read_gate()\n        yield None")
+        findings = disciplines.check(files)
+        assert any(f.rule == "MT-Y801" for f in findings), [
+            f.render() for f in findings]
+
+    def test_bypassing_chunk_owned_turns_tree_red(self, tmp_path):
+        from mpit_tpu.analysis import ownership
+
+        files = self._doctored(
+            tmp_path, "ps/server.py",
+            "self._chunk_owned(body.view(self.dtype))",
+            "body.view(self.dtype)")
+        findings = ownership.check(files)
+        assert any(f.rule in ("MT-D901", "MT-D903") for f in findings), [
+            f.render() for f in findings]
+
+    def test_dropping_device_copy_on_seed_turns_tree_red(self, tmp_path):
+        from mpit_tpu.analysis import ownership
+
+        files = self._doctored(
+            tmp_path, "dplane/hbm.py",
+            "self.param = device_copy(place_flat(value, self.config))",
+            "self.param = place_flat(value, self.config)")
+        findings = ownership.check(files)
+        assert any(f.rule == "MT-D903" for f in findings), [
+            f.render() for f in findings]
+
+    def test_caching_bare_snapshot_turns_tree_red(self, tmp_path):
+        from mpit_tpu.analysis import ownership
+
+        files = self._doctored(
+            tmp_path, "dplane/hbm.py",
+            "self._snap_host = (self.version, np.asarray(self.param))",
+            "self._snap_host = (self.version, self.param)")
+        findings = ownership.check(files)
+        assert any(f.rule == "MT-D902" for f in findings), [
+            f.render() for f in findings]
+
+    def test_spawn_inside_window_is_not_a_yield(self):
+        # The semantic pin the whole family rests on: sched.spawn(gen())
+        # primes only the NEW task (aio/scheduler.py), so the clean
+        # fixture's _dispatch_read — which spawns a generator inside the
+        # declared window — must verify (covered by
+        # test_clean_fixture_is_silent; asserted here directly).
+        from mpit_tpu.analysis import callgraph, disciplines
+        from mpit_tpu.analysis.core import collect
+
+        files, _ = collect(CLEANPKG)
+        graph = callgraph.build_graph(files)
+        section = next(s for s in disciplines.SECTIONS
+                       if s.name == "ps-read-gate-window")
+        assert disciplines.section_findings(graph, section) == []
+
+
 # -- content-hash suppression keys ------------------------------------------
 
 
@@ -540,3 +733,32 @@ class TestContentHashBaseline:
         assert r.returncode == 1
         assert "[[suppress]]" in r.stdout
         assert 'content = "' in r.stdout
+        # The new families get content-keyed entries like everyone else.
+        for rule in ("MT-Y801", "MT-Y802", "MT-Y803",
+                     "MT-D901", "MT-D902", "MT-D903"):
+            assert f'rule = "{rule}"' in r.stdout, rule
+
+    def test_suggest_baseline_rejects_colliding_content_key(self, tmp_path):
+        # An existing baseline entry already claims the content hash of
+        # a flagged line (under a different rule, so the finding stays
+        # unsuppressed).  Suggesting another content entry with the same
+        # key would silently merge the two — the CLI must pin by line
+        # instead, loudly.
+        from mpit_tpu.analysis.core import content_key
+
+        flagged = (BADPKG / "locks.py").read_text().splitlines()[26]
+        key = content_key(flagged)  # locks.py:27 — the MT-C202 seed
+        cfg = tmp_path / "mtlint.toml"
+        cfg.write_text(
+            '[[suppress]]\nrule = "MT-C203"\nfile = "locks.py"\n'
+            f'content = "{key}"\n'
+            'reason = "test: same content hash claimed by another rule"\n')
+        r = subprocess.run(
+            [sys.executable, "tools/mtlint.py",
+             "tests/fixtures/mtlint/badpkg", "--suggest-baseline",
+             "--config", str(cfg)],
+            cwd=str(REPO), capture_output=True, text=True)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "already claimed" in r.stdout
+        assert f'content = "{key}"' not in r.stdout
+        assert "line = 27" in r.stdout
