@@ -229,6 +229,23 @@ AGG_LINK_MBS = float(os.environ.get("MPIT_BENCH_AGG_LINK_MBS", "300"))
 AGG_ROUNDS = int(os.environ.get("MPIT_BENCH_AGG_ROUNDS", "5"))
 AGG_CHUNK_MB = float(os.environ.get("MPIT_BENCH_AGG_CHUNK_MB", "4"))
 AGG_DEADLINE = float(os.environ.get("MPIT_BENCH_AGG_DEADLINE", "600"))
+# MPIT_BENCH_POOL=1: run the stream and agg sweeps once per worker-pool
+# configuration (ISSUE 17, comm/pool.py) — first MPIT_POOL_THREADS=0
+# (the serial data plane, today's control) then once per entry of
+# MPIT_BENCH_POOL_THREADS (default "2") — and tag every row
+# pool_threads=N.  The knob must pin BOTH sides explicitly: the pool
+# defaults to min(4, cores-1), which is 0 (serial) on the 1-core bench
+# container, so an untagged run would silently A/A.  Chunked stream
+# rows record pool_grad_speedup (this leg's GRAD p50 vs the pool=0
+# leg's, same codec) and agg tree rows record pool_speedup the same
+# way — the cross-leg column that shows what pooling itself bought,
+# next to the within-leg chunked-vs-control / tree-vs-flat bars.
+# Pool rows ride the modeled-wire sweeps and never join the codec=none
+# baseline gate.
+POOL_SWEEP = os.environ.get("MPIT_BENCH_POOL", "") not in ("", "0")
+POOL_THREADS = [int(x) for x in
+                os.environ.get("MPIT_BENCH_POOL_THREADS", "2").split(",")
+                if x.strip()]
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
 # (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
@@ -453,58 +470,88 @@ def bench_stream() -> list:
 
     global NSERVERS, NCLIENTS
     saved = (NSERVERS, NCLIENTS)
+    saved_pool = os.environ.get("MPIT_POOL_THREADS")
     NSERVERS = NCLIENTS = 1
     size = int(MB * (1 << 20) / 4)
     chunk_bytes = int(STREAM_CHUNK_MB * (1 << 20))
     rows = []
+    # None = inherit the caller's pool config (sweep off, today's rows
+    # keep their shape); with MPIT_BENCH_POOL, the explicit 0 control
+    # first, then each pooled thread count.  Children pick the value up
+    # from MPIT_POOL_THREADS in their env.
+    pool_legs = ([0] + [n for n in POOL_THREADS if n > 0]
+                 if POOL_SWEEP else [None])
+    serial_grad = {}  # codec -> pool=0 chunked GRAD p50
     try:
-        for codec in (CODECS or ["none"]):
-            os.environ["MPIT_PS_CODEC"] = codec or "none"
-            pair = {}
-            for chunked in (0, 1):
-                spec = {"chunk_bytes": chunk_bytes if chunked else 0,
+        for pool_n in pool_legs:
+            if pool_n is not None:
+                os.environ["MPIT_POOL_THREADS"] = str(pool_n)
+            for codec in (CODECS or ["none"]):
+                os.environ["MPIT_PS_CODEC"] = codec or "none"
+                pair = {}
+                for chunked in (0, 1):
+                    spec = {"chunk_bytes": chunk_bytes if chunked else 0,
+                            "link_mbs": STREAM_LINK_MBS,
+                            "deadline_s": STREAM_DEADLINE}
+                    out: dict = {}
+                    _log(f"[stream] codec {codec or 'none'} "
+                         f"{'chunked' if chunked else 'control'}: 1s/1c, "
+                         f"link {STREAM_LINK_MBS:.0f} MB/s, payload "
+                         f"{size * 4 / 2**20:.0f} MB"
+                         + (f", {STREAM_CHUNK_MB:.0f} MB chunks"
+                            if chunked else "")
+                         + (f", pool {pool_n}t" if pool_n is not None
+                            else ""))
+                    mbs = _shm_run_procs(size, stream=spec, stream_out=out)
+                    gp50 = float(np.percentile(out["lat_grad"], 50)) * 1e3
+                    pp50 = float(np.percentile(out["lat_param"], 50)) * 1e3
+                    row = {
+                        "metric": "ps_stream_pipeline",
+                        "unit": "ms",
+                        "value": round(gp50, 1),
+                        "codec": codec or "none",
+                        "stream": chunked,
+                        "grad_p50_ms": round(gp50, 1),
+                        "param_p50_ms": round(pp50, 1),
+                        "aggregate_mbs": round(mbs, 1),
                         "link_mbs": STREAM_LINK_MBS,
-                        "deadline_s": STREAM_DEADLINE}
-                out: dict = {}
-                _log(f"[stream] codec {codec or 'none'} "
-                     f"{'chunked' if chunked else 'control'}: 1s/1c, "
-                     f"link {STREAM_LINK_MBS:.0f} MB/s, payload "
-                     f"{size * 4 / 2**20:.0f} MB"
-                     + (f", {STREAM_CHUNK_MB:.0f} MB chunks"
-                        if chunked else ""))
-                mbs = _shm_run_procs(size, stream=spec, stream_out=out)
-                gp50 = float(np.percentile(out["lat_grad"], 50)) * 1e3
-                pp50 = float(np.percentile(out["lat_param"], 50)) * 1e3
-                row = {
-                    "metric": "ps_stream_pipeline",
-                    "unit": "ms",
-                    "value": round(gp50, 1),
-                    "codec": codec or "none",
-                    "stream": chunked,
-                    "grad_p50_ms": round(gp50, 1),
-                    "param_p50_ms": round(pp50, 1),
-                    "aggregate_mbs": round(mbs, 1),
-                    "link_mbs": STREAM_LINK_MBS,
-                    "chunk_mb": STREAM_CHUNK_MB if chunked else 0,
-                    "payload_mb": round(size * 4 / 2**20, 1),
-                    "rounds": ROUNDS,
-                    "retries": out.get("retries", 0),
-                }
-                rows.append(row)
-                pair[chunked] = row
-            speedup = (pair[0]["grad_p50_ms"]
-                       / max(pair[1]["grad_p50_ms"], 1e-9))
-            pair[1]["grad_speedup"] = round(speedup, 2)
-            pair[1]["param_speedup"] = round(
-                pair[0]["param_p50_ms"]
-                / max(pair[1]["param_p50_ms"], 1e-9), 2)
-            _log(f"[stream] codec {codec or 'none'}: GRAD p50 "
-                 f"{pair[0]['grad_p50_ms']:.0f} -> "
-                 f"{pair[1]['grad_p50_ms']:.0f} ms ({speedup:.2f}x), "
-                 f"PARAM p50 {pair[0]['param_p50_ms']:.0f} -> "
-                 f"{pair[1]['param_p50_ms']:.0f} ms")
+                        "chunk_mb": STREAM_CHUNK_MB if chunked else 0,
+                        "payload_mb": round(size * 4 / 2**20, 1),
+                        "rounds": ROUNDS,
+                        "retries": out.get("retries", 0),
+                    }
+                    if pool_n is not None:
+                        row["pool_threads"] = pool_n
+                    rows.append(row)
+                    pair[chunked] = row
+                speedup = (pair[0]["grad_p50_ms"]
+                           / max(pair[1]["grad_p50_ms"], 1e-9))
+                pair[1]["grad_speedup"] = round(speedup, 2)
+                pair[1]["param_speedup"] = round(
+                    pair[0]["param_p50_ms"]
+                    / max(pair[1]["param_p50_ms"], 1e-9), 2)
+                if pool_n == 0:
+                    serial_grad[codec] = pair[1]["grad_p50_ms"]
+                elif pool_n and serial_grad.get(codec):
+                    pair[1]["pool_grad_speedup"] = round(
+                        serial_grad[codec]
+                        / max(pair[1]["grad_p50_ms"], 1e-9), 2)
+                _log(f"[stream] codec {codec or 'none'}"
+                     + (f" pool {pool_n}t" if pool_n is not None else "")
+                     + f": GRAD p50 "
+                     f"{pair[0]['grad_p50_ms']:.0f} -> "
+                     f"{pair[1]['grad_p50_ms']:.0f} ms ({speedup:.2f}x), "
+                     f"PARAM p50 {pair[0]['param_p50_ms']:.0f} -> "
+                     f"{pair[1]['param_p50_ms']:.0f} ms"
+                     + (f", pooled GRAD {pair[1]['pool_grad_speedup']:.2f}x"
+                        f" vs serial"
+                        if "pool_grad_speedup" in pair[1] else ""))
     finally:
         NSERVERS, NCLIENTS = saved
+        if saved_pool is None:
+            os.environ.pop("MPIT_POOL_THREADS", None)
+        else:
+            os.environ["MPIT_POOL_THREADS"] = saved_pool
     return rows
 
 
@@ -597,42 +644,79 @@ def bench_agg() -> list:
     hierarchical rows >= 1.3x the flat row."""
     import numpy as np
 
+    from mpit_tpu.comm import pool as comm_pool
+
     size = int(AGG_MB * (1 << 20) / 4)
     rows = []
-    for codec in (CODECS or ["none", "int8"]):
-        flat_mbs = None
-        for mode in ("flat", "prereduce", "tree"):
-            _log(f"[agg] {mode} codec {codec}: 1s/{AGG_CLIENTS}c "
-                 f"threads, link {AGG_LINK_MBS:.0f} MB/s, payload "
-                 f"{AGG_MB:.0f} MB x {AGG_ROUNDS} rounds")
-            r = _agg_gang_run(mode, size, codec=codec)
-            mbs = AGG_CLIENTS * AGG_ROUNDS * size * 4 / r["dt"] / 2**20
-            row = {
-                "metric": "ps_agg_hierarchy",
-                "unit": "MB/s",
-                "value": round(mbs, 1),
-                "mode": mode,
-                "codec": codec,
-                "aggregate_mbs": round(mbs, 1),
-                "round_p50_ms": round(
-                    float(np.percentile(r["lat"], 50)) * 1e3, 1),
-                "grads_applied": r["applied"],
-                "clients": AGG_CLIENTS,
-                "link_mbs": AGG_LINK_MBS,
-                "payload_mb": round(AGG_MB, 1),
-                "rounds": AGG_ROUNDS,
-            }
-            if mode == "flat":
-                flat_mbs = mbs
+    # The agg gang is in-process (threads share the group plane), so
+    # the pool legs reconfigure the process-wide pool directly instead
+    # of relying on child env.  None = inherit (sweep off).
+    pool_legs = ([0] + [n for n in POOL_THREADS if n > 0]
+                 if POOL_SWEEP else [None])
+    serial_tree = {}  # codec -> pool=0 tree aggregate MB/s
+    saved_pool = os.environ.get("MPIT_POOL_THREADS")
+    try:
+        for pool_n in pool_legs:
+            if pool_n is not None:
+                os.environ["MPIT_POOL_THREADS"] = str(pool_n)
+                comm_pool.configure(pool_n)
+            for codec in (CODECS or ["none", "int8"]):
+                flat_mbs = None
+                for mode in ("flat", "prereduce", "tree"):
+                    _log(f"[agg] {mode} codec {codec}: 1s/{AGG_CLIENTS}c "
+                         f"threads, link {AGG_LINK_MBS:.0f} MB/s, payload "
+                         f"{AGG_MB:.0f} MB x {AGG_ROUNDS} rounds"
+                         + (f", pool {pool_n}t" if pool_n is not None
+                            else ""))
+                    r = _agg_gang_run(mode, size, codec=codec)
+                    mbs = (AGG_CLIENTS * AGG_ROUNDS * size * 4
+                           / r["dt"] / 2**20)
+                    row = {
+                        "metric": "ps_agg_hierarchy",
+                        "unit": "MB/s",
+                        "value": round(mbs, 1),
+                        "mode": mode,
+                        "codec": codec,
+                        "aggregate_mbs": round(mbs, 1),
+                        "round_p50_ms": round(
+                            float(np.percentile(r["lat"], 50)) * 1e3, 1),
+                        "grads_applied": r["applied"],
+                        "clients": AGG_CLIENTS,
+                        "link_mbs": AGG_LINK_MBS,
+                        "payload_mb": round(AGG_MB, 1),
+                        "rounds": AGG_ROUNDS,
+                    }
+                    if pool_n is not None:
+                        row["pool_threads"] = pool_n
+                    if mode == "flat":
+                        flat_mbs = mbs
+                    else:
+                        row["speedup_vs_flat"] = round(
+                            mbs / max(flat_mbs, 1e-9), 2)
+                    if mode == "tree":
+                        if pool_n == 0:
+                            serial_tree[codec] = mbs
+                        elif pool_n and serial_tree.get(codec):
+                            row["pool_speedup"] = round(
+                                mbs / max(serial_tree[codec], 1e-9), 2)
+                    rows.append(row)
+                    _log(f"[agg] {mode} codec {codec}"
+                         + (f" pool {pool_n}t" if pool_n is not None
+                            else "")
+                         + f": {mbs:.1f} MB/s "
+                         f"aggregate, round p50 {row['round_p50_ms']:.0f}"
+                         f" ms, applied {r['applied']}"
+                         + (f", {row['speedup_vs_flat']:.2f}x vs flat"
+                            if mode != "flat" else "")
+                         + (f", {row['pool_speedup']:.2f}x vs serial tree"
+                            if "pool_speedup" in row else ""))
+    finally:
+        if POOL_SWEEP:
+            if saved_pool is None:
+                os.environ.pop("MPIT_POOL_THREADS", None)
             else:
-                row["speedup_vs_flat"] = round(
-                    mbs / max(flat_mbs, 1e-9), 2)
-            rows.append(row)
-            _log(f"[agg] {mode} codec {codec}: {mbs:.1f} MB/s "
-                 f"aggregate, round p50 {row['round_p50_ms']:.0f} ms, "
-                 f"applied {r['applied']}"
-                 + (f", {row['speedup_vs_flat']:.2f}x vs flat"
-                    if mode != "flat" else ""))
+                os.environ["MPIT_POOL_THREADS"] = saved_pool
+            comm_pool.configure(None)
     return rows
 
 

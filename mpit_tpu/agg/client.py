@@ -54,6 +54,7 @@ from mpit_tpu.agg.wire import (
     reduce_ack_frame,
     unpack_reduce_header,
 )
+from mpit_tpu.comm import pool as comm_pool
 from mpit_tpu.ft import RetryExhausted, chunk_elems_for, chunk_spans, \
     chunk_stride, pack_chunk_header, pack_tx_stamp
 from mpit_tpu.obs import clock as obs_clock
@@ -596,6 +597,8 @@ class AggClient:
         resend_at = time.monotonic() + op_dl
         if not self._children:
             fold_set = []
+        pool = comm_pool.get_pool()
+        fold_jobs: Dict[int, object] = {}
         span.mark("fold")
         while ready < nchunks or (remaining_acks and not fallback):
             if self._children:
@@ -629,11 +632,26 @@ class AggClient:
                 while ready < nchunks and all(
                         ready in self._child_rounds[c][seq].seen
                         for c in fold_set):
-                    lo, hi = self._spans_of[ready]
-                    np.copyto(self._acc[lo:hi], self._own[lo:hi])
-                    for c in fold_set:
-                        self._acc[lo:hi] += \
-                            self._child_rounds[c][seq].buf[lo:hi]
+                    # Fused fold through the pool seam: one single-pass
+                    # kernel replaces copyto + one += sweep per child,
+                    # preserving the serial loop's exact association
+                    # order ((own + c0) + c1) + ... over the *sorted*
+                    # fold_set — the bitwise anchor.  With workers the
+                    # fold of chunk k runs off-thread while chunk k+1's
+                    # REDUCE frames are still arriving; serial runs it
+                    # inline (same bytes either way).
+                    if ready not in fold_jobs:
+                        fold_jobs[ready] = self._submit_fold(
+                            seq, fold_set, ready)
+                    nxt = ready + 1
+                    if (not pool.serial and nxt < nchunks
+                            and nxt not in fold_jobs
+                            and all(nxt in self._child_rounds[c][seq].seen
+                                    for c in fold_set)):
+                        fold_jobs[nxt] = self._submit_fold(
+                            seq, fold_set, nxt)
+                    if not fold_jobs[ready].done():
+                        break  # keep draining children; collect next pass
                     if ready == 0:
                         nfold += sum(self._child_rounds[c][seq].nfold
                                      for c in fold_set)
@@ -739,6 +757,18 @@ class AggClient:
             span.mark("send")  # the gated streams own the wire from here
         span.end("ok")
         return True
+
+    def _submit_fold(self, seq: int, fold_set: List[int], idx: int):
+        """One pure fold job for chunk ``idx``: own + every committed
+        child's chunk, in sorted ``fold_set`` order, into the disjoint
+        accumulator slice.  Operands are quiescent until collection —
+        child round buffers are only retired after the round's last
+        fold is collected, and the Job pins them regardless."""
+        lo, hi = self._spans_of[idx]
+        return comm_pool.get_pool().submit_fold_f32(
+            self._own[lo:hi],
+            [self._child_rounds[c][seq].buf[lo:hi] for c in fold_set],
+            self._acc[lo:hi])
 
     def _forward_chunk(self, seq: int, idx: int, count: int, nfold: int,
                        resend: bool = False):

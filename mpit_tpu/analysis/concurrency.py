@@ -29,6 +29,16 @@ also lets MT-C202 see *through* helpers:
   held-set — their bodies run later, not under the enclosing lock.
   (The interprocedural variant — a lock held across a *call* that
   yields — is MT-Y803 in mpit_tpu.analysis.disciplines.)
+- **MT-C204** — a blocking worker-pool wait (``Job.result()``, the raw
+  ``mt_pool_wait`` it wraps, or the ``mt_pool_close`` thread join) must
+  not run while a lock is held NOR inside a declared no-yield atomic
+  section (mpit_tpu.analysis.disciplines.SECTIONS): the wait stalls
+  the one scheduler thread on work that may be queued *behind* jobs
+  only this thread can collect, and inside an atomic window it turns
+  "no yield" into "no progress".  Those contexts poll the nonblocking
+  ``Job.done()`` between scheduler turns or use the ``*_sync`` seam
+  entries (comm/pool.py).  Resolved through same-file helpers like
+  MT-C202.
 """
 
 from __future__ import annotations
@@ -36,7 +46,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Tuple
 
-from mpit_tpu.analysis import callgraph
+from mpit_tpu.analysis import callgraph, disciplines
 from mpit_tpu.analysis.core import Finding, SourceFile, register_rules
 
 # Re-exported for compatibility: the lock/blocking model moved into the
@@ -51,7 +61,60 @@ register_rules({
     "MT-C201": ("error", "lock-order inversion (A->B here, B->A elsewhere)"),
     "MT-C202": ("warn", "blocking call while holding a lock"),
     "MT-C203": ("error", "scheduler yield inside a lock region"),
+    "MT-C204": ("error", "blocking worker-pool wait under a lock or inside "
+                         "a declared no-yield window"),
 })
+
+
+# -- MT-C204: the blocking-pool-wait model -----------------------------------
+
+#: Terminal callees that stall the calling thread on the native worker
+#: pool: the raw per-handle wait and the close-time thread join.
+#: ``Job.done()`` is the nonblocking probe and never matches.
+_POOL_WAIT_CALLEES = {"mt_pool_wait", "mt_pool_close"}
+
+
+def _is_pool_wait(cs: callgraph.CallSite) -> bool:
+    """Does this call site block on the worker pool?  ``job.result()``
+    by the receiver convention of comm/pool.py (a Job is always named
+    ``job``/``jobs[...]``/``fold_jobs[...]``), the raw native waits by
+    exact name."""
+    if cs.callee in _POOL_WAIT_CALLEES:
+        return True
+    return cs.callee == "result" and "job" in cs.receiver.lower()
+
+
+def _pool_wait_witness(graph: callgraph.CallGraph, fn: callgraph.FnInfo,
+                       _seen=None) -> Optional[str]:
+    """Witness string when calling ``fn`` reaches a blocking pool wait
+    through any depth of same-file helpers; None otherwise."""
+    seen = set() if _seen is None else _seen
+    if fn in seen:
+        return None
+    seen.add(fn)
+    for cs in fn.calls:
+        if _is_pool_wait(cs):
+            recv = cs.receiver + "." if cs.receiver else ""
+            return f"{fn.name} calls {recv}{cs.callee}() (line {cs.line})"
+        for target in graph.resolve(fn, cs):
+            sub = _pool_wait_witness(graph, target, seen)
+            if sub is not None:
+                return f"{fn.name} -> {sub}"
+    return None
+
+
+def _call_pool_wait(graph: callgraph.CallGraph, fn: callgraph.FnInfo,
+                    cs: callgraph.CallSite) -> Optional[str]:
+    """Witness if THIS call site blocks on the pool (directly or via
+    same-file helpers)."""
+    if _is_pool_wait(cs):
+        recv = cs.receiver + "." if cs.receiver else ""
+        return f"{recv}{cs.callee}()"
+    for target in graph.resolve(fn, cs):
+        sub = _pool_wait_witness(graph, target)
+        if sub is not None:
+            return sub
+    return None
 
 
 def check(files: List[SourceFile],
@@ -97,6 +160,38 @@ def check(files: List[SourceFile],
                     f"{fn.qual} yields to the scheduler while holding "
                     f"{lock} (acquired line {lline}) — the parked task "
                     "wedges every other task that needs the lock"))
+
+    # MT-C204 — blocking pool waits: (a) never with a lock held ...
+    for fn in graph.functions:
+        for cs in fn.calls:
+            if cs.lock is None or cs.guarded:
+                continue
+            witness = _call_pool_wait(graph, fn, cs)
+            if witness is not None:
+                lock, lline = cs.lock
+                findings.append(fn.src.finding(
+                    "MT-C204", cs.node,
+                    f"{fn.qual} blocks on the worker pool ({witness}) "
+                    f"while holding {lock} (acquired line {lline}) — the "
+                    "lock is pinned until jobs queued behind this one "
+                    "drain; poll Job.done() or wait outside the lock"))
+    # ... and (b) never inside a declared no-yield atomic section: the
+    # window promised "no scheduler progress needed"; a pool wait makes
+    # progress depend on worker scheduling instead.
+    for section in disciplines.SECTIONS:
+        for fn, start in disciplines._section_windows(graph, section):
+            for cs in fn.calls:
+                if cs.line < start:
+                    continue
+                witness = _call_pool_wait(graph, fn, cs)
+                if witness is not None:
+                    findings.append(fn.src.finding(
+                        "MT-C204", cs.node,
+                        f"{fn.qual} blocks on the worker pool ({witness}) "
+                        f"inside the declared atomic section "
+                        f"'{section.name}' (window starts line {start}) — "
+                        "use the *_sync seam entries there; "
+                        f"{section.doc}"))
 
     # MT-C201 — pairwise inversions within one file (lock identities
     # are only comparable inside a file: two classes may both name a

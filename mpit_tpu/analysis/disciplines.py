@@ -203,6 +203,39 @@ SINKS: Tuple[OwnedSink, ...] = (
         fn="_apply_chunk",
         doc="the legacy per-shard chunk apply has the same aliasing "
             "contract as the fused path."),
+    OwnedSink(
+        "ps-grad-apply-owned", "ps/server.py", "apply_wire", 1,
+        receiver="hbm", fn="_recv_grad",
+        doc="unframed GRAD apply, device path: the ack round trip does "
+            "NOT serialize rx-buffer reuse — the jitted apply only "
+            "dispatches before the ack goes out, so the operand handed "
+            "to apply_wire must be an owned copy of the reused gbuf "
+            "views, never the views themselves."),
+    OwnedSink(
+        "ps-grad-apply-owned-legacy", "ps/server.py", "apply_fn", 1,
+        fn="_recv_grad",
+        doc="unframed GRAD apply, legacy host path: same aliasing "
+            "contract — jnp.asarray zero-copy-aliases aligned host "
+            "memory while the async apply is still reading it."),
+    OwnedSink(
+        "pool-client-decode-owned", "ps/client.py", "submit_decode", 1,
+        receiver="pool",
+        doc="PR 17 pool seam: the wire slice handed to a pooled decode "
+            "job is read by a worker thread while the scheduler loop "
+            "recycles the rx frame for the next chunk — the caller must "
+            "submit an owned snapshot (np.array), never the frame view."),
+    OwnedSink(
+        "pool-server-scatter-owned", "ps/server.py", "submit_scatter", 5,
+        receiver="pool",
+        doc="PR 17 pool seam: the chunk body a pooled scatter reads "
+            "must be owned — the server's rx buffer is reused per "
+            "message while the job may still be copying from it."),
+    OwnedSink(
+        "cells-xor-owned-out", "cells/wire.py", "xor_sync", 2,
+        receiver="pool",
+        doc="§11 DELTA production/install: the XOR kernel's output must "
+            "be a fresh owned buffer (np.empty) — reply tasks may still "
+            "hold zero-copy views of the old frame (copy-on-write)."),
 )
 
 PATHS: Tuple[OwnedPath, ...] = (
@@ -226,6 +259,18 @@ PATHS: Tuple[OwnedPath, ...] = (
         "asarray", "device_copy",
         doc="the non-sharded restore staging wraps jnp.asarray (which "
             "aliases host memory on the CPU backend) in device_copy."),
+    OwnedPath(
+        "pool-client-decode-owned-copy", "ps/client.py", "_chunked_read",
+        "array", "submit_decode",
+        doc="the owning snapshot of the rx frame is constructed exactly "
+            "at the pool submit boundary — an np.array in the chunked "
+            "read loop outside submit_decode(...) is a stray copy that "
+            "hides the ownership transfer."),
+    OwnedPath(
+        "pool-server-scatter-owned-copy", "ps/server.py",
+        "_recv_param_chunked", "array", "submit_scatter",
+        doc="same contract on the server scatter side: the owned copy "
+            "of the rx body exists only as the pool submit argument."),
 )
 
 SLOTS: Tuple[DonatedSlot, ...] = (

@@ -876,6 +876,21 @@ def _defines_param_server(tree: ast.Module) -> bool:
                for node in ast.walk(tree))
 
 
+def _defines_param_client(tree: ast.Module) -> bool:
+    return any(isinstance(node, ast.ClassDef) and node.name == "ParamClient"
+               for node in ast.walk(tree))
+
+
+def _declares_wire_names(spec: WireModuleSpec, src: SourceFile) -> bool:
+    """Is this file plausibly the registry's wire module — i.e. does it
+    declare any of the spec's constants or pack/parse functions?"""
+    consts = _module_consts(src.tree)
+    if any(name in consts for name in spec.constants):
+        return True
+    fns = _top_functions(src.tree)
+    return any(name in fns for name in (*spec.packers, *spec.parsers))
+
+
 def _check_negotiation(src: SourceFile) -> List[Finding]:
     """MT-S604/MT-S605 over ``ParamServer._negotiate``: the INIT length
     dispatch must accept exactly the schema's versions, and the pure
@@ -992,7 +1007,13 @@ def check(files: List[SourceFile]) -> List[Finding]:
     for src in files:
         rel = src.rel
         for spec in WIRE_MODULES:
-            if rel.endswith(spec.suffix):
+            # Scoped to files that declare at least one registry name:
+            # ownership-discipline fixtures reuse a wire-module path
+            # suffix (e.g. cells/wire.py) to pick up the declared pool
+            # disciplines without carrying the full frame vocabulary.
+            # The real module always declares some of them, so any
+            # single deletion/drift still fails conformance.
+            if rel.endswith(spec.suffix) and _declares_wire_names(spec, src):
                 findings += _check_wire_module(spec, src)
         if rel.endswith("ps/tags.py"):
             findings += _check_tags_module(src)
@@ -1002,7 +1023,8 @@ def check(files: List[SourceFile]) -> List[Finding]:
             # fixtures reuse the ps/server.py path suffix to pick up the
             # declared disciplines without carrying a full INIT dispatch.
             findings += _check_negotiation(src)
-        if rel.endswith("ps/client.py"):
+        if rel.endswith("ps/client.py") and _defines_param_client(src.tree):
+            # Same scoping for the client side (ParamClient).
             findings += _check_announce(src)
     return findings
 

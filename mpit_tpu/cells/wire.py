@@ -43,6 +43,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from mpit_tpu.comm import pool as comm_pool
+
 #: int64 [kind, from_version, to_version, head_version, body_nbytes]
 DIFF_HDR_WORDS = 5
 DIFF_HDR_BYTES = 8 * DIFF_HDR_WORDS
@@ -166,7 +168,13 @@ def xor_delta(frame_from: np.ndarray, frame_to: np.ndarray) -> np.ndarray:
         raise ValueError(
             f"encoded frames differ in size ({a.size} vs {b.size}) — "
             "not one snapshot stream")
-    return np.bitwise_xor(a, b)
+    # Synchronous kernel entry: delta production runs on the serve path
+    # (ps/server.py answers DIFF_REQ inline), so it must not queue behind
+    # other pool jobs.  The fresh np.empty output is the owned buffer the
+    # 'cells-xor-owned-out' discipline pins.
+    out = np.empty(a.size, np.uint8)
+    comm_pool.get_pool().xor_sync(a, b, out)
+    return out
 
 
 def apply_delta(frame: np.ndarray, delta: np.ndarray) -> np.ndarray:
@@ -176,7 +184,12 @@ def apply_delta(frame: np.ndarray, delta: np.ndarray) -> np.ndarray:
     if a.size != delta.size:
         raise ValueError(
             f"delta is {delta.size} bytes against a {a.size}-byte frame")
-    return np.bitwise_xor(a, delta)
+    # Synchronous: the caller sits inside the cell-install-atomic no-yield
+    # window (cells/cell.py _install), where a blocking pool wait is
+    # exactly what MT-C204 forbids — so never a queued submit here.
+    out = np.empty(a.size, np.uint8)
+    comm_pool.get_pool().xor_sync(as_u8(delta), a, out)
+    return out
 
 
 def diff_req(epoch: int, seq: int, have_version: int) -> np.ndarray:

@@ -28,13 +28,16 @@
 #include <atomic>
 #include <cerrno>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <deque>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <utility>
 #include <vector>
 
@@ -646,6 +649,296 @@ void mt_codec_bf16_decode(const void* vwire, uint64_t n, void* vout) {
   for (uint64_t i = 0; i < n; ++i) {
     dst[i] = (uint32_t)src[i] << 16;
   }
+}
+
+// -- data-plane kernels for the worker pool ----------------------------------
+//
+// Byte-wise XOR delta (cells FrameHistory DELTA production and apply) and
+// the fused f32 add-fold (agg interior-node per-chunk fold).  Both are
+// single-pass replacements for multi-pass numpy pipelines; both must stay
+// bit-identical to the numpy reference (tests/test_pool.py parity suite):
+// XOR trivially is, and the fold keeps numpy's association order
+// ((own[i] + c0[i]) + c1[i]) + ... element-wise with -ffp-contract=off,
+// so no FMA ever merges an add pair the serial path keeps separate.
+
+void mt_xor_bytes(const void* va, const void* vb, void* vout, int64_t n) {
+  const uint8_t* a = static_cast<const uint8_t*>(va);
+  const uint8_t* b = static_cast<const uint8_t*>(vb);
+  uint8_t* out = static_cast<uint8_t*>(vout);
+  int64_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t x, y;
+    memcpy(&x, a + i, 8);
+    memcpy(&y, b + i, 8);
+    x ^= y;
+    memcpy(out + i, &x, 8);
+  }
+  for (; i < n; ++i) out[i] = (uint8_t)(a[i] ^ b[i]);
+}
+
+// vptrs: uint64_t[nchildren] raw child-buffer addresses, each f32[n].
+// The serial agg fold does copyto(acc, own) then one `acc += child` pass
+// per child — nchildren+1 DRAM round trips over the chunk.  This fuses
+// them into one read pass over every operand and one write pass, keeping
+// the exact per-element association order of the serial loop.
+void mt_fold_f32(const void* vown, const void* vptrs, int32_t nchildren,
+                 void* vout, int64_t n) {
+  const float* own = static_cast<const float*>(vown);
+  const uint64_t* ptrs = static_cast<const uint64_t*>(vptrs);
+  float* out = static_cast<float*>(vout);
+  for (int64_t i = 0; i < n; ++i) {
+    float acc = own[i];
+    for (int32_t c = 0; c < nchildren; ++c) {
+      acc += reinterpret_cast<const float*>((uintptr_t)ptrs[c])[i];
+    }
+    out[i] = acc;
+  }
+}
+
+// Bumped whenever specs/*.json and this file change together; the
+// generated _bindings.py refuses a stale .so (loud rebuild message)
+// instead of failing with a confusing missing-symbol AttributeError.
+// Keep in sync with MT_API_VERSION in gen_bindings.py.
+int64_t mt_api_version(void) { return 17001; }
+
+}  // extern "C"
+
+// -- worker-pool data plane --------------------------------------------------
+//
+// A persistent native thread pool so chunk encode/decode/XOR/fold runs off
+// the Python critical thread (the GIL cap recorded by BENCH_r15/r16).  Jobs
+// are pure: owned input pointers -> owned output pointers, all regions
+// disjoint per job, per-block int8 EF state (the residual slice) carried in
+// the job.  Completion order therefore never influences byte content; the
+// Python seam (mpit_tpu/comm/pool.py) collects results in submission order.
+
+namespace {
+
+enum PoolJobKind {
+  kJobInt8Enc = 1,
+  kJobInt8Dec = 2,
+  kJobBf16Enc = 3,
+  kJobBf16Dec = 4,
+  kJobXor = 5,
+  kJobFoldF32 = 6,
+  kJobCopy = 7,
+};
+constexpr int32_t kJobKinds = 8;  // valid kinds are 1..kJobKinds-1
+
+struct PoolJob {
+  uint64_t handle = 0;
+  int32_t kind = 0;
+  const void* a = nullptr;  // primary input
+  const void* b = nullptr;  // secondary input (residual / xor rhs / ptrs)
+  void* c = nullptr;        // primary output
+  void* d = nullptr;        // secondary output (int8 codes)
+  int64_t n = 0;
+  int64_t aux = 0;                // fold: nchildren
+  std::vector<uint64_t> ptrs;     // fold: owned copy of child addresses
+};
+
+struct Pool {
+  std::mutex mu;
+  std::condition_variable cv_work;  // workers: queue non-empty or closing
+  std::condition_variable cv_done;  // waiters: a job completed
+  std::deque<PoolJob> queue;
+  std::map<uint64_t, int> state;  // handle -> 0 pending, 1 done
+  std::vector<std::thread> threads;
+  uint64_t next_handle = 1;
+  bool closing = false;
+  int64_t running = 0;
+  uint64_t jobs_by_kind[kJobKinds] = {0};
+  std::atomic<uint64_t> busy_ns{0};
+};
+
+void pool_run(const PoolJob& job) {
+  switch (job.kind) {
+    case kJobInt8Enc:
+      mt_codec_int8_encode(job.a, const_cast<void*>(job.b), (uint64_t)job.n,
+                           job.c, job.d);
+      break;
+    case kJobInt8Dec:
+      mt_codec_int8_decode(job.a, job.b, (uint64_t)job.n, job.c);
+      break;
+    case kJobBf16Enc:
+      mt_codec_bf16_encode(job.a, (uint64_t)job.n, job.c);
+      break;
+    case kJobBf16Dec:
+      mt_codec_bf16_decode(job.a, (uint64_t)job.n, job.c);
+      break;
+    case kJobXor:
+      mt_xor_bytes(job.a, job.b, job.c, job.n);
+      break;
+    case kJobFoldF32:
+      mt_fold_f32(job.a, job.ptrs.data(), (int32_t)job.aux, job.c, job.n);
+      break;
+    case kJobCopy:
+      memcpy(job.c, job.a, (size_t)job.n);
+      break;
+    default:
+      break;
+  }
+}
+
+void pool_worker(Pool* pool) {
+  for (;;) {
+    PoolJob job;
+    {
+      std::unique_lock<std::mutex> lk(pool->mu);
+      pool->cv_work.wait(
+          lk, [pool] { return pool->closing || !pool->queue.empty(); });
+      if (pool->queue.empty()) return;  // closing and fully drained
+      job = std::move(pool->queue.front());
+      pool->queue.pop_front();
+      pool->running++;
+    }
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    pool_run(job);
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    uint64_t ns = (uint64_t)(t1.tv_sec - t0.tv_sec) * 1000000000ull +
+                  (uint64_t)(t1.tv_nsec - t0.tv_nsec);
+    {
+      std::lock_guard<std::mutex> lk(pool->mu);
+      pool->running--;
+      pool->state[job.handle] = 1;
+      pool->jobs_by_kind[job.kind]++;
+      pool->busy_ns.fetch_add(ns, std::memory_order_relaxed);
+    }
+    pool->cv_done.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Spawn a pool with nthreads workers; NULL when nthreads <= 0 (callers
+// treat that as "stay serial").  Pools are instance-scoped like mt_init
+// contexts so tests can run several geometries side by side.
+void* mt_pool_start(int32_t nthreads) {
+  if (nthreads <= 0) return nullptr;
+  Pool* pool = new Pool();
+  pool->threads.reserve((size_t)nthreads);
+  for (int32_t i = 0; i < nthreads; ++i) {
+    pool->threads.emplace_back(pool_worker, pool);
+  }
+  return pool;
+}
+
+// Drain every queued job, join all workers, free the pool.  Submitting to
+// a closed pool is the caller's error (the Python seam raises before it
+// can reach a freed pointer).
+void mt_pool_close(void* vpool) {
+  auto* pool = static_cast<Pool*>(vpool);
+  if (pool == nullptr) return;
+  {
+    std::lock_guard<std::mutex> lk(pool->mu);
+    pool->closing = true;
+  }
+  pool->cv_work.notify_all();
+  for (auto& t : pool->threads) t.join();
+  delete pool;
+}
+
+int32_t mt_pool_threads(void* vpool) {
+  auto* pool = static_cast<Pool*>(vpool);
+  return pool == nullptr ? 0 : (int32_t)pool->threads.size();
+}
+
+// Enqueue one pure job; returns a handle (> 0), or 0 when the pool is
+// closing or the job is malformed.  Operand meaning by kind:
+//   INT8_ENC  a=x f32[n], b=residual f32[n]|NULL, c=scales, d=codes
+//   INT8_DEC  a=scales, b=codes, c=out f32[n]
+//   BF16_ENC  a=x f32[n], c=wire u16[n]      BF16_DEC a=wire, c=out
+//   XOR       a, b, c = out, n bytes
+//   FOLD_F32  a=own f32[n], b=u64[aux] child addresses (copied), c=out
+//   COPY      a=src, c=dst, n bytes
+// Buffers must stay alive until the job completes (zero-copy rule; the
+// Python Job object holds the references).
+uint64_t mt_pool_submit(void* vpool, int32_t kind, const void* a,
+                        const void* b, void* c, void* d, int64_t n,
+                        int64_t aux) {
+  auto* pool = static_cast<Pool*>(vpool);
+  if (pool == nullptr || kind <= 0 || kind >= kJobKinds || n < 0) return 0;
+  PoolJob job;
+  job.kind = kind;
+  job.a = a;
+  job.b = b;
+  job.c = c;
+  job.d = d;
+  job.n = n;
+  job.aux = aux;
+  if (kind == kJobFoldF32) {
+    if (b == nullptr || aux < 0) return 0;
+    const uint64_t* ptrs = static_cast<const uint64_t*>(b);
+    job.ptrs.assign(ptrs, ptrs + aux);  // owned copy: caller may free b
+  }
+  uint64_t handle;
+  {
+    std::lock_guard<std::mutex> lk(pool->mu);
+    if (pool->closing) return 0;
+    handle = pool->next_handle++;
+    job.handle = handle;
+    pool->state[handle] = 0;
+    pool->queue.push_back(std::move(job));
+  }
+  pool->cv_work.notify_one();
+  return handle;
+}
+
+// 1 done (handle retired), 0 pending, -1 unknown.
+int32_t mt_pool_poll(void* vpool, uint64_t handle) {
+  auto* pool = static_cast<Pool*>(vpool);
+  if (pool == nullptr) return -1;
+  std::lock_guard<std::mutex> lk(pool->mu);
+  auto it = pool->state.find(handle);
+  if (it == pool->state.end()) return -1;
+  if (it->second == 0) return 0;
+  pool->state.erase(it);
+  return 1;
+}
+
+// Block until the job completes (ctypes drops the GIL for the duration);
+// 0 ok (handle retired), -1 unknown.
+int32_t mt_pool_wait(void* vpool, uint64_t handle) {
+  auto* pool = static_cast<Pool*>(vpool);
+  if (pool == nullptr) return -1;
+  std::unique_lock<std::mutex> lk(pool->mu);
+  auto it = pool->state.find(handle);
+  if (it == pool->state.end()) return -1;
+  pool->cv_done.wait(lk, [pool, handle] {
+    auto jt = pool->state.find(handle);
+    return jt == pool->state.end() || jt->second == 1;
+  });
+  pool->state.erase(handle);
+  return 0;
+}
+
+// Jobs submitted but not yet finished (queued + running).
+int64_t mt_pool_depth(void* vpool) {
+  auto* pool = static_cast<Pool*>(vpool);
+  if (pool == nullptr) return 0;
+  std::lock_guard<std::mutex> lk(pool->mu);
+  return (int64_t)pool->queue.size() + pool->running;
+}
+
+// Completed-job count for one kind, or the total when kind == 0.
+uint64_t mt_pool_jobs(void* vpool, int32_t kind) {
+  auto* pool = static_cast<Pool*>(vpool);
+  if (pool == nullptr || kind < 0 || kind >= kJobKinds) return 0;
+  std::lock_guard<std::mutex> lk(pool->mu);
+  if (kind != 0) return pool->jobs_by_kind[kind];
+  uint64_t total = 0;
+  for (int32_t k = 1; k < kJobKinds; ++k) total += pool->jobs_by_kind[k];
+  return total;
+}
+
+// Cumulative worker seconds spent inside kernels.
+double mt_pool_busy_seconds(void* vpool) {
+  auto* pool = static_cast<Pool*>(vpool);
+  if (pool == nullptr) return 0.0;
+  return 1e-9 * (double)pool->busy_ns.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

@@ -226,6 +226,9 @@ def _rank_row(rank: int, sample: Optional[dict],
         "agg_fallbacks": int(
             metric_sum(m, "mpit_agg_direct_fallbacks_total")),
         "inflight": len(status.get("inflight_ops") or []),
+        # Pooled data plane (comm/pool.py): chunk kernels dispatched to
+        # the native worker pool — 0 on serial-fallback ranks.
+        "pool_jobs": int(metric_sum(m, "mpit_pool_jobs_total")),
     }
     # SLO columns (ISSUE 11): BUSY-reply ratio (admission rejections
     # over ops — windowed against the previous refresh when one exists)
@@ -297,7 +300,7 @@ _COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "slo", "busy%",
             "sendq", "conns",
             "busy", "stale", "retry", "evict", "shards", "busy_s", "mapv",
             "gang", "cellv", "lag", "rdrs", "rrt", "fanin", "late", "fb",
-            "infl")
+            "pool", "infl")
 
 
 def render_table(rows: List[Dict[str, object]]) -> str:
@@ -339,6 +342,9 @@ def render_table(rows: List[Dict[str, object]]) -> str:
             str(row["agg_fanin"]) if row.get("agg_fanin") else "-",
             str(row["agg_late"]) if row.get("agg_late") else "-",
             str(row["agg_fallbacks"]) if row.get("agg_fallbacks") else "-",
+            # Worker-pool column: pooled kernel jobs dispatched —
+            # serial-fallback ranks show '-'.
+            str(row["pool_jobs"]) if row.get("pool_jobs") else "-",
             str(row["inflight"]),
         ]
 

@@ -64,6 +64,7 @@ from mpit_tpu.aio import (
     deadline_at,
 )
 from mpit_tpu.comm import codec as codec_mod
+from mpit_tpu.comm import pool as comm_pool
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.ft import (
     ACK_TIMING_WORDS,
@@ -1102,16 +1103,36 @@ class ParamClient:
         nchunks = len(spans_)
         span.note(epoch=self.ft.epoch, seq=seq, chunks=nchunks)
         span.mark("encode")
-        pending: Dict[int, object] = {}
-        for k, (lo, hi) in enumerate(spans_):
+        pool = comm_pool.get_pool()
+        jobs: Dict[int, object] = {}
+
+        def _stage_chunk(k: int) -> None:
+            # One pure encode job per chunk: disjoint staging slot,
+            # disjoint BLOCK-aligned residual slice (the int8 EF state
+            # rides in the job), input views quiescent until collect.
+            lo, hi = spans_[k]
             frame = staging[k * stride: (k + 1) * stride]
             body = frame[self._chdr: self._chdr + self._chunk_body(hi - lo)]
             if self.codec.identity:
-                body[:] = view[lo:hi].view(np.uint8)
+                jobs[k] = pool.submit_copy(view[lo:hi].view(np.uint8), body)
             else:
-                self.codec.encode_into(
-                    view[lo:hi], body,
+                jobs[k] = pool.submit_encode(
+                    self.codec, view[lo:hi], body,
                     residual=None if residual is None else residual[lo:hi])
+
+        # With workers, chunk k+1 encodes on the pool while chunk k is
+        # on the wire; serial (lookahead 0) keeps today's exact order.
+        lookahead = 0 if pool.serial else 1
+        pending: Dict[int, object] = {}
+        for k, (lo, hi) in enumerate(spans_):
+            for j in range(k, min(k + 1 + lookahead, nchunks)):
+                if j not in jobs:
+                    _stage_chunk(j)
+            if not jobs[k].done():
+                span.mark("pool_collect")
+                while not jobs[k].done():
+                    yield EXEC
+            frame = staging[k * stride: (k + 1) * stride]
             pack_chunk_header(frame, self.ft.epoch, seq, k, nchunks)
             if self._timing:
                 pack_tx_stamp(frame, self._chdr, obs_clock.wall_us())
@@ -1119,7 +1140,7 @@ class ParamClient:
             pending[k] = self.transport.isend(frame, srank, tag)
             # Yield between chunks: the transport pumps chunk k toward
             # the peer (and sibling pumps get their turn) while this
-            # generator comes back to encode chunk k+1.
+            # generator comes back to collect/encode chunk k+1.
             yield EXEC
         yield from self._chunk_acks(srank, tag, ack_tag, seq, staging,
                                     pending, span, what)
@@ -1260,6 +1281,11 @@ class ParamClient:
         req = (timed_frame(self.ft.epoch, seq, 0) if self._timing
                else header_frame(self.ft.epoch, seq))
         last: Optional[BaseException] = None
+        # Decode jobs are per-op, not per-attempt: a timed-out attempt's
+        # in-flight job must be collected before the retry re-decodes
+        # the same slice, or the older bytes could land second.
+        pool = comm_pool.get_pool()
+        jobs: Dict[int, object] = {}
         for attempt in range(self._retry.attempts):
             if attempt:
                 backoff = self._retry.backoff_s(attempt)
@@ -1323,10 +1349,30 @@ class ParamClient:
                     body = frame[self._chdr_rx:
                                  self._chdr_rx + self._chunk_body(hi - lo)]
                     if self.codec.identity:
+                        # One memcpy — pooling would only add a second.
                         out[lo:hi].view(np.uint8)[:] = body
-                    else:
+                    elif pool.serial:
                         self.codec.decode_into(body, out[lo:hi])
+                    else:
+                        # ``frame`` is the reused rx staging buffer: the
+                        # next irecv overwrites it while a worker reads,
+                        # so the job's input must be an owned snapshot
+                        # (discipline 'pool-client-decode-owned').  A
+                        # version restart re-decodes a chunk; the prior
+                        # job must land first so the newer bytes win.
+                        prior = jobs.pop(idx, None)
+                        if prior is not None and not prior.done():
+                            span.mark("pool_collect")
+                            while not prior.done():
+                                yield EXEC
+                        jobs[idx] = pool.submit_decode(
+                            self.codec, np.array(body), out[lo:hi])
                     if len(seen) == cnt:
+                        for job in jobs.values():
+                            if not job.done():
+                                span.mark("pool_collect")
+                                while not job.done():
+                                    yield EXEC
                         span.end("ok")
                         return
             except DeadlineExceeded as exc:

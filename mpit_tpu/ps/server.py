@@ -77,6 +77,7 @@ from mpit_tpu.aio import (
     deadline_at,
 )
 from mpit_tpu.comm import codec as codec_mod
+from mpit_tpu.comm import pool as comm_pool
 from mpit_tpu.comm.transport import Transport
 from mpit_tpu.cells import wire as _cellwire
 from mpit_tpu.ft import (
@@ -1017,8 +1018,14 @@ class ParamServer:
         if codec.identity:
             wire = host
         else:
+            # Through the pool seam's synchronous entry: this helper is
+            # part of the declared 'ps-read-path-helpers' no-yield
+            # window, so the encode runs inline (never queued — a pool
+            # wait here would block the scheduler mid-atomic-section,
+            # lint rule MT-C204).  The kernel itself is the GIL-free
+            # native one when available.
             wire = np.empty(codec.wire_nbytes(self.size), np.uint8)
-            codec.encode_into(host, wire)
+            comm_pool.get_pool().encode_sync(codec, host, wire)
         self._snap_wire[codec.name] = (version, wire)
         return wire
 
@@ -1297,14 +1304,31 @@ class ParamServer:
         itemsize = np.dtype(self.dtype).itemsize
         handles = []
         span.mark("send")
+        # Gather jobs are pure: the snapshot wire is immutable for its
+        # version (a new version allocates a fresh frame, never rewrites
+        # this one — the Job pins it) and each chunk's staging slot is
+        # disjoint.  With workers, the gather of chunk k+1 runs on the
+        # pool while chunk k is on the wire; serial keeps today's order.
+        pool = comm_pool.get_pool()
+        jobs: Dict[int, object] = {}
+        lookahead = 0 if pool.serial else 1
         for k, (lo, hi) in enumerate(spans_):
+            for j in range(k, min(k + 1 + lookahead, len(spans_))):
+                if j not in jobs:
+                    jlo, jhi = spans_[j]
+                    jframe = staging[j * stride: (j + 1) * stride]
+                    jobs[j] = pool.submit_gather(
+                        codec, wire_u8, self.size, jlo, jhi,
+                        jframe[chdr:], itemsize=itemsize)
             frame = staging[k * stride: (k + 1) * stride]
             pack_chunk_reply(frame, epoch, seq, k, len(spans_), version)
             if timing:
                 pack_reply_stamps(frame, chdr - TIMING_TAIL_BYTES,
                                   int(req[2]), t_recv, obs_clock.wall_us())
-            codec_mod.gather_chunk(codec, wire_u8, self.size, lo, hi,
-                                   frame[chdr:], itemsize=itemsize)
+            if not jobs[k].done():
+                span.mark("pool_collect")
+                while not jobs[k].done():
+                    yield EXEC
             if k:
                 span.mark("chunk")
             handles.append(self.transport.isend(frame, crank, tags.PARAM))
@@ -1339,6 +1363,8 @@ class ParamServer:
         rxbuf = self._chunk_rx_push[crank]
         spans_ = chunk_spans(self.size, self._chunk[crank])
         itemsize = np.dtype(self.dtype).itemsize
+        pool = comm_pool.get_pool()
+        jobs: Dict[int, object] = {}
         while self.live.on:
             got = yield from aio_recv(
                 self.transport, crank, tags.PARAM_PUSH, live=self.live,
@@ -1375,8 +1401,23 @@ class ParamServer:
                 self._chunk_asm[crank] = asm
             lo, hi = spans_[idx]
             body = rxbuf[chdr: chdr + self._chunk_body_for(codec, hi - lo)]
-            codec_mod.scatter_chunk(codec, asm, self.size, lo, hi, body,
-                                    itemsize=itemsize)
+            if pool.serial:
+                codec_mod.scatter_chunk(codec, asm, self.size, lo, hi, body,
+                                        itemsize=itemsize)
+            else:
+                # ``rxbuf`` is the reused push rx buffer: the next recv
+                # overwrites it while a worker reads, so the job's input
+                # must be an owned snapshot (discipline
+                # 'pool-server-scatter-owned').  A resent chunk under a
+                # new (epoch, seq) reuses the same assembly region, so
+                # any prior job on this index must land first.
+                prior = jobs.pop(idx, None)
+                if prior is not None:
+                    while not prior.done():
+                        yield EXEC
+                jobs[idx] = pool.submit_scatter(
+                    codec, asm, self.size, lo, hi, np.array(body),
+                    itemsize=itemsize)
             if not done:
                 yield from self._send_chunk_ack(
                     crank, tags.PARAM_PUSH_ACK, epoch, seq, idx, gen,
@@ -1392,6 +1433,13 @@ class ParamServer:
                     "resume clients with seed_servers=False", crank,
                 )
             span.mark("apply")
+            # Every scatter must have landed before the assembly buffer
+            # is read (jobs write disjoint regions; collection order is
+            # irrelevant to the bytes).
+            for job in jobs.values():
+                while not job.done():
+                    yield EXEC
+            jobs.clear()
             if codec.identity:
                 # Owned copy: the assembly buffer is reused by the next
                 # push while jax may still alias this seed's bytes
@@ -2104,21 +2152,33 @@ class ParamServer:
                     span.note(staleness=staleness)
                     self._stale_hist(crank).observe(staleness)
             span.mark("apply")
+            # The apply's operands are owned copies of the rx views
+            # (:meth:`_chunk_owned` — `ps-grad-apply-owned`, MT-D901).
+            # The GRAD_ACK below does NOT serialize buffer reuse: the
+            # jitted apply only *dispatches* before the ack goes out,
+            # and jax zero-copy-aliases aligned host arrays, so the
+            # next GRAD landing in ``gbuf`` would race the in-flight
+            # execution (visible as wrong applied bytes whenever the
+            # backend queue is backed up, e.g. first-call compiles).
             if self._hbm is not None:
                 # Device-resident path: the slot's donated fused
                 # decode+apply — same math, same operand order as the
                 # legacy jit below, so both runs stay bitwise equal.
                 self._hbm.apply_wire(
-                    codec, data if parts is None else parts)
+                    codec,
+                    self._chunk_owned(data if data is not None else gbuf)
+                    if parts is None
+                    else [self._chunk_owned(v) for v in parts])
                 self.param = self._hbm.param
                 self.rule_state = self._hbm.rule_state
             else:
                 with self._dev_ctx():
                     if parts is None:
-                        grad_in: Any = jnp.asarray(
-                            data if data is not None else gbuf)
+                        grad_in: Any = jnp.asarray(self._chunk_owned(
+                            data if data is not None else gbuf))
                     else:
-                        grad_in = [jnp.asarray(v) for v in parts]
+                        grad_in = [jnp.asarray(self._chunk_owned(v))
+                                   for v in parts]
                     self.param, self.rule_state = apply_fn(
                         self.param, grad_in, self.rule_state
                     )

@@ -1,0 +1,13 @@
+"""Seeded XOR-kernel ownership violation (mtlint fixture — parsed,
+never imported).  The rel-path suffix ``cells/wire.py`` puts the
+cells-xor-owned-out sink in scope."""
+
+import numpy as np
+
+
+def bad_delta(pool, a, b, scratch):
+    # MT-D901 (cells-xor-owned-out): the kernel output aliases borrowed
+    # storage instead of a fresh owned buffer.
+    out = np.frombuffer(scratch, np.uint8)
+    pool.xor_sync(a, b, out)
+    return out

@@ -1,0 +1,21 @@
+"""Seeded worker-pool wait violations (mtlint fixture — parsed, never
+imported)."""
+
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.job = None
+
+    def hold_and_collect(self):
+        with self._lock:
+            self.job.result()  # MT-C204: blocking pool wait, lock held
+
+    def _drain_job(self):
+        self.job.result()
+
+    def hold_and_drain(self):
+        with self._lock:
+            self._drain_job()  # MT-C204: blocks one helper down

@@ -1,0 +1,17 @@
+"""Seeded pooled-decode ownership violations (mtlint fixture — parsed,
+never imported).  The rel-path suffix ``ps/client.py`` puts the pooled
+chunked-read disciplines in scope."""
+
+import numpy as np
+
+
+class Client:
+    def _chunked_read(self, body, out, lo, hi):
+        # MT-D901 (pool-client-decode-owned): the reused rx frame view
+        # goes to the pool without an owning snapshot.
+        job = self.pool.submit_decode(
+            self.codec, np.frombuffer(body, np.uint8), out[lo:hi])
+        # MT-D903 (pool-client-decode-owned-copy): a stray copy outside
+        # the submit boundary.
+        spare = np.array(body)
+        return job, spare

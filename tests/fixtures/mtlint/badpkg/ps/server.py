@@ -34,3 +34,18 @@ class PS:
         # MT-D903: ownership of a bare parameter cannot be proven at
         # the declared seam.
         self._hbm.apply_wire_chunk(codec, grad, lo)
+
+    def _snapshot_wire(self):
+        # MT-C204: blocking pool wait inside the declared yield-free
+        # read-path window (ps-read-path-helpers).
+        self.job.result()
+        return self._wire
+
+    def _recv_param_chunked(self, codec, asm, lo, hi, blob):
+        # MT-D901 (pool-server-scatter-owned): a frombuffer view of the
+        # reused receive buffer submitted to the worker pool.
+        self.pool.submit_scatter(
+            codec, asm, self.size, lo, hi, np.frombuffer(blob, np.uint8))
+        # MT-D903 (pool-server-scatter-owned-copy): a stray owning copy
+        # outside the submit boundary.
+        return np.array(blob)

@@ -1,0 +1,10 @@
+"""Clean XOR-kernel twin (mtlint fixture — zero findings): the kernel
+writes into a fresh owned buffer (copy-on-write frames)."""
+
+import numpy as np
+
+
+def good_delta(pool, a, b):
+    out = np.empty(len(a), np.uint8)
+    pool.xor_sync(a, b, out)
+    return out

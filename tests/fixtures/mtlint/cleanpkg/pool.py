@@ -1,0 +1,26 @@
+"""Clean worker-pool wait twins (mtlint fixture — zero findings):
+nonblocking polls are fine under a lock, blocking collection happens
+lock-free, and close joins the workers outside the mutex (the shape
+comm/pool.py's ``close()`` uses)."""
+
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.job = None
+        self.pool = None
+
+    def poll_under_lock(self):
+        with self._lock:
+            return self.job.done()  # nonblocking probe — fine under a lock
+
+    def collect(self):
+        self.job.result()  # blocking wait with no lock held
+
+    def close(self):
+        with self._lock:
+            pool, self.pool = self.pool, None
+        if pool is not None:
+            self.native.mt_pool_close(pool)  # join outside the mutex

@@ -1,0 +1,11 @@
+"""Clean pooled-decode twin (mtlint fixture — zero findings): the
+owning snapshot of the rx frame is constructed exactly at the pool
+submit boundary."""
+
+import numpy as np
+
+
+class Client:
+    def _chunked_read(self, body, out, lo, hi):
+        return self.pool.submit_decode(
+            self.codec, np.array(body), out[lo:hi])

@@ -61,3 +61,9 @@ class PS:
 
     def staged_apply(self, codec, blob, lo):
         self._hbm.apply_wire_chunk(codec, self._staged(blob), lo)
+
+    def _recv_param_chunked(self, codec, asm, lo, hi, blob):
+        # The owning snapshot exists only as the pool submit argument —
+        # the declared pool-server-scatter-owned shape.
+        self.pool.submit_scatter(
+            codec, asm, self.size, lo, hi, np.array(blob))

@@ -154,19 +154,25 @@ class TestSeededViolations:
         assert "dplane-single-writer" in hits[0].message
 
     def test_unowned_buffer_at_seam_detected(self, bad):
-        # MT-D901: a frombuffer view reaches the donated chunk apply —
-        # exactly one finding.
+        # MT-D901: a frombuffer view reaches the donated chunk apply,
+        # plus the three pool-seam seeds (server scatter, client decode,
+        # cells XOR out) — one finding each, nothing else.
         hits = bad.get("MT-D901", [])
-        assert [(f.path, f.line) for f in hits] == [("ps/server.py", 31)]
-        assert "frombuffer" in hits[0].message
+        assert {(f.path, f.line) for f in hits} == {
+            ("ps/server.py", 31), ("ps/server.py", 47),
+            ("ps/client.py", 12), ("cells/wire.py", 12)}
+        assert all("frombuffer" in f.message for f in hits)
 
     def test_ownership_wrapper_dropped_detected(self, bad):
         # MT-D903, both shapes: an unprovable sink argument
         # (ps/server.py) and a declared owned path whose device_copy
-        # wrapper is gone (dplane/hbm.py) — exactly one finding each.
+        # wrapper is gone (dplane/hbm.py) — plus the pool-seam
+        # owned-copy paths: a stray np.array outside the submit
+        # boundary on both the client decode and server scatter sides.
         hits = bad.get("MT-D903", [])
         assert {(f.path, f.line) for f in hits} == {
-            ("ps/server.py", 36), ("dplane/hbm.py", 14)}
+            ("ps/server.py", 36), ("dplane/hbm.py", 14),
+            ("ps/client.py", 16), ("ps/server.py", 51)}
 
     def test_donated_slot_leak_detected(self, bad):
         # MT-D902: snapshot_host caches the bare donated buffer —
@@ -189,6 +195,28 @@ class TestSeededViolations:
     def test_yield_under_lock_detected(self, bad):
         hits = bad.get("MT-C203", [])
         assert [(f.path, f.line) for f in hits] == [("locks.py", 31)]
+
+    def test_pool_wait_under_lock_detected(self, bad):
+        # MT-C204 lock half: hold_and_collect blocks on a pool job with
+        # _lock held (direct), hold_and_drain one helper down — one
+        # finding each, at the call site under the lock.
+        hits = sorted((f for f in bad.get("MT-C204", [])
+                       if f.path == "pool.py"), key=lambda f: f.line)
+        assert [(f.path, f.line) for f in hits] == [
+            ("pool.py", 14), ("pool.py", 21)]
+        assert "result" in hits[0].message
+        assert "_drain_job" in hits[1].message
+
+    def test_pool_wait_in_atomic_window_detected(self, bad):
+        # MT-C204 window half: a Job.result() inside the declared
+        # yield-free read-path window — exactly one finding, naming
+        # the section.  The cleanpkg done()-under-lock and
+        # join-outside-mutex twins must be silent
+        # (test_clean_fixture_is_silent).
+        hits = [f for f in bad.get("MT-C204", [])
+                if f.path == "ps/server.py"]
+        assert [(f.path, f.line) for f in hits] == [("ps/server.py", 41)]
+        assert "ps-read-path-helpers" in hits[0].message
 
     def test_traced_branch_detected(self, bad):
         hits = bad.get("MT-J302", [])
@@ -556,7 +584,9 @@ class TestDisciplines:
         assert {"ps-read-gate-window", "dplane-single-writer",
                 "aggplane-single-writer", "reader-single-writer",
                 "cell-stream-single-writer",
-                "chunk-apply-owned-seam"} <= names
+                "chunk-apply-owned-seam",
+                "pool-client-decode-owned", "pool-server-scatter-owned",
+                "cells-xor-owned-out"} <= names
 
     def test_cli_report_and_exit_codes(self, tmp_path):
         report = tmp_path / "disc.json"
@@ -634,6 +664,36 @@ class TestDisciplines:
             "self.param = place_flat(value, self.config)")
         findings = ownership.check(files)
         assert any(f.rule == "MT-D903" for f in findings), [
+            f.render() for f in findings]
+
+    def test_dropping_decode_snapshot_turns_tree_red(self, tmp_path):
+        # The pool seam's ownership pin: submitting the reused rx frame
+        # to a pooled decode without the np.array snapshot must flag.
+        from mpit_tpu.analysis import ownership
+
+        files = self._doctored(
+            tmp_path, "ps/client.py",
+            "self.codec, np.array(body), out[lo:hi])",
+            "self.codec, body, out[lo:hi])")
+        findings = ownership.check(files)
+        assert any(f.rule in ("MT-D901", "MT-D903") for f in findings), [
+            f.render() for f in findings]
+
+    def test_pool_wait_in_real_window_turns_tree_red(self, tmp_path):
+        # MT-C204's window half against the real tree: a blocking
+        # Job.result() planted inside _snapshot_wire (a declared
+        # yield-free read-path helper) must flag.
+        from mpit_tpu.analysis import callgraph, concurrency
+
+        files = self._doctored(
+            tmp_path, "ps/server.py",
+            'def _snapshot_wire(self, codec: "codec_mod.Codec") '
+            "-> np.ndarray:",
+            'def _snapshot_wire(self, codec: "codec_mod.Codec") '
+            "-> np.ndarray:\n        self.job.result()")
+        graph = callgraph.build_graph(files)
+        findings = concurrency.check(files, graph)
+        assert any(f.rule == "MT-C204" for f in findings), [
             f.render() for f in findings]
 
     def test_caching_bare_snapshot_turns_tree_red(self, tmp_path):
