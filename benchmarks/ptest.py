@@ -31,6 +31,11 @@ docs/PROTOCOL.md §5).  MPIT_BENCH_REPS (default 1 here) repeats each
 shm leg and reports the median + per-run values.  MPIT_BENCH_DECOMP=1
 adds a causally-traced leg whose row carries per-phase p50/p99 latency
 from `obs analyze` (docs/OBSERVABILITY.md, *Causal op tracing*).
+MPIT_BENCH_PROFILE=1 adds the CPU/utilization attribution columns from
+`obs profile` (per-rank core use, pool overlap efficiency, the
+encode-while-wire fraction) to a gate-exempt codec=none overhead leg,
+the chunked stream legs and the agg legs (docs/OBSERVABILITY.md,
+*CPU/utilization attribution*).
 
 Prints one JSON line per mode (and per codec in a sweep): MB/s
 bi-directional, plus per-chip for the ici mode.  MB/s counts *logical*
@@ -246,6 +251,22 @@ POOL_SWEEP = os.environ.get("MPIT_BENCH_POOL", "") not in ("", "0")
 POOL_THREADS = [int(x) for x in
                 os.environ.get("MPIT_BENCH_POOL_THREADS", "2").split(",")
                 if x.strip()]
+# MPIT_BENCH_PROFILE=1: the CPU/utilization attribution columns
+# (ISSUE 19, obs/profile.py).  Three touchpoints: (1) one extra
+# codec=none shm leg with MPIT_OBS_PROFILE=1 + trace export in every
+# child, analyzed by `obs profile` so the row carries per-rank core
+# use and counter-sample counts — the overhead column.  The row is
+# EXCLUDED from the codec=none baseline gate like the skew/decomp
+# legs: per-step thread-clock reads on a time-shared 1-core host are
+# a measured ~2x tax (BENCH_r17), which is exactly what the column
+# records — the plain codec=none leg in the same run still gates;
+# (2) the
+# chunked stream legs run profiled, recording pool overlap efficiency
+# and the encode-while-wire fraction next to their latencies; (3) the
+# agg legs profile in-process (scheduler-attributed CPU + pool busy
+# over the leg's wall) so tree rows carry utilization.  Captured
+# columns: BENCH_r17.json.
+PROFILE_SWEEP = os.environ.get("MPIT_BENCH_PROFILE", "") not in ("", "0")
 # MPIT_BENCH_BASELINE=<MB/s>: fail the run if any codec=none shm leg
 # (heartbeats/obs on or off) lands below 97% of this reference — the
 # regression gate for the captured record (PR 2: 252.7 at 640 MB).
@@ -273,7 +294,7 @@ def bench_ici() -> dict:
 def bench_shm(codec: str = "", heartbeat: bool = False,
               obs: bool = False, skew_rebalance=None,
               status: bool = False, decomp: bool = False,
-              throttle_mbs: float = 0.0) -> dict:
+              throttle_mbs: float = 0.0, profile: bool = False) -> dict:
     """One shm PS push/pull measurement; ``codec`` overrides
     MPIT_PS_CODEC for the gang (read at client/server construction);
     ``heartbeat`` arms client beacons + the server lease registry;
@@ -285,7 +306,12 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
     replies and runs the gang in shardctl mode with the rebalance policy
     off (False) or on (True); ``decomp`` arms the causal-tracing column:
     framed FLAG_TIMING wire + per-rank trace parts, merged and fed
-    through ``obs analyze`` so the row carries per-phase p50/p99."""
+    through ``obs analyze`` so the row carries per-phase p50/p99;
+    ``profile`` arms the CPU-attribution column: MPIT_OBS_PROFILE +
+    trace export in every child, merged and fed through ``obs
+    profile`` so the row carries per-rank core use (gate-exempt like
+    decomp: the per-step clock tax is the measured column, not a wire
+    regression)."""
     import numpy as np
 
     from mpit_tpu.comm import codec as codec_mod
@@ -302,20 +328,22 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
             if skew_rebalance is not None else "")
          + f"payload {size * 4 / 2**20:.1f} MB x {REPS} rep(s)")
 
-    if (heartbeat or obs or status or decomp) and GANG != "procs":
+    if (heartbeat or obs or status or decomp or profile) and GANG != "procs":
         raise RuntimeError(
             "MPIT_BENCH_HEARTBEAT/MPIT_BENCH_OBS/MPIT_BENCH_STATUS/"
-            "MPIT_BENCH_DECOMP need MPIT_BENCH_GANG=procs")
+            "MPIT_BENCH_DECOMP/MPIT_BENCH_PROFILE need MPIT_BENCH_GANG=procs")
     if skew_rebalance is not None and GANG != "procs":
         raise RuntimeError("MPIT_BENCH_SKEW needs MPIT_BENCH_GANG=procs")
     polls = [0]
     decomp_out: dict = {}
+    profile_out: dict = {}
     if GANG == "procs":
         runs = [_shm_run_procs(size, heartbeat=heartbeat, obs=obs,
                                skew_rebalance=skew_rebalance,
                                status_port=STATUS_PORT if status else None,
                                status_polls=polls,
                                decomp_out=decomp_out if decomp else None,
+                               profile_out=profile_out if profile else None,
                                throttle_mbs=throttle_mbs)
                 for _ in range(REPS)]
     else:
@@ -347,6 +375,12 @@ def bench_shm(codec: str = "", heartbeat: bool = False,
         # column next to the MB/s it cost to measure it.
         row["decomp"] = 1
         row.update(decomp_out)
+    if profile:
+        # CPU/utilization attribution from the last rep's analyzed
+        # trace (obs/profile.py) — per-rank core use next to the MB/s
+        # it cost to measure it.
+        row["profile"] = 1
+        row.update(profile_out)
     if skew_rebalance is not None:
         row["skew"] = 1
         row["rebalance"] = int(bool(skew_rebalance))
@@ -494,6 +528,11 @@ def bench_stream() -> list:
                             "link_mbs": STREAM_LINK_MBS,
                             "deadline_s": STREAM_DEADLINE}
                     out: dict = {}
+                    # Profiled chunked legs (MPIT_BENCH_PROFILE): the
+                    # attribution plane rides the leg, so pool overlap
+                    # efficiency and the encode-while-wire fraction
+                    # land next to the latencies they explain.
+                    prof_out = {} if (PROFILE_SWEEP and chunked) else None
                     _log(f"[stream] codec {codec or 'none'} "
                          f"{'chunked' if chunked else 'control'}: 1s/1c, "
                          f"link {STREAM_LINK_MBS:.0f} MB/s, payload "
@@ -502,7 +541,8 @@ def bench_stream() -> list:
                             if chunked else "")
                          + (f", pool {pool_n}t" if pool_n is not None
                             else ""))
-                    mbs = _shm_run_procs(size, stream=spec, stream_out=out)
+                    mbs = _shm_run_procs(size, stream=spec, stream_out=out,
+                                         profile_out=prof_out)
                     gp50 = float(np.percentile(out["lat_grad"], 50)) * 1e3
                     pp50 = float(np.percentile(out["lat_param"], 50)) * 1e3
                     row = {
@@ -522,6 +562,9 @@ def bench_stream() -> list:
                     }
                     if pool_n is not None:
                         row["pool_threads"] = pool_n
+                    if prof_out:
+                        row["profile"] = 1
+                        row.update(prof_out)
                     rows.append(row)
                     pair[chunked] = row
                 speedup = (pair[0]["grad_p50_ms"]
@@ -565,6 +608,27 @@ def _agg_gang_run(mode: str, size: int, codec: str = "none") -> dict:
     from mpit_tpu.comm.local import LocalRouter
     from mpit_tpu.ft import FTConfig, LinkClock, PacedTransport
 
+    # In-process profiling (MPIT_BENCH_PROFILE): the agg gang is
+    # threads, so the attribution plane is enabled programmatically
+    # BEFORE roles construct (capture-at-construction) and the leg
+    # reads the shared profiler + the native pool's busy clock
+    # directly instead of a child trace.
+    prof = None
+    if PROFILE_SWEEP:
+        from mpit_tpu import obs as obs_pkg
+        from mpit_tpu.obs import profile as obs_profile
+
+        obs_pkg.configure(enabled=True, reset=True)
+        obs_profile.configure(enabled=True)
+        prof = obs_profile.get_profiler()
+    busy0 = 0.0
+    if prof is not None:
+        from mpit_tpu.comm import pool as comm_pool
+
+        pool = comm_pool.current_pool()
+        if pool is not None and not pool.serial:
+            pool.sample_obs()
+            busy0 = pool.busy_seconds()
     nclients = AGG_CLIENTS
     router = LocalRouter(1 + nclients)
     cranks = list(range(1, 1 + nclients))
@@ -633,8 +697,24 @@ def _agg_gang_run(mode: str, size: int, codec: str = "none") -> dict:
         c.stop()
     sth.join(60)
     assert not sth.is_alive(), "agg bench server never stopped"
-    return {"dt": t1 - t0, "lat": lat,
-            "applied": server.grads_applied}
+    out = {"dt": t1 - t0, "lat": lat,
+           "applied": server.grads_applied}
+    if prof is not None:
+        from mpit_tpu import obs as obs_pkg
+        from mpit_tpu.comm import pool as comm_pool
+
+        wall = max(t1 - t0, 1e-9)
+        res = {"sched_cpu_s": round(prof.cpu_seconds, 3),
+               "cpu_util": round(prof.cpu_seconds / wall, 3)}
+        pool = comm_pool.current_pool()
+        if pool is not None and not pool.serial:
+            pool.sample_obs()
+            res["pool_util"] = round(
+                max(pool.busy_seconds() - busy0, 0.0)
+                / (wall * max(pool.threads, 1)), 3)
+        obs_pkg.configure(enabled=None, reset=True)
+        out["profile"] = res
+    return out
 
 
 def bench_agg() -> list:
@@ -688,6 +768,12 @@ def bench_agg() -> list:
                     }
                     if pool_n is not None:
                         row["pool_threads"] = pool_n
+                    if r.get("profile"):
+                        # In-process utilization (MPIT_BENCH_PROFILE):
+                        # scheduler-attributed CPU + pool busy over the
+                        # leg's wall window.
+                        row["profile"] = 1
+                        row.update(r["profile"])
                     if mode == "flat":
                         flat_mbs = mbs
                     else:
@@ -756,7 +842,8 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
                    obs: bool = False, skew_rebalance=None,
                    status_port=None, status_polls=None,
                    decomp_out=None, throttle_mbs: float = 0.0,
-                   stream=None, stream_out=None) -> float:
+                   stream=None, stream_out=None,
+                   profile_out=None) -> float:
     """One timed gang, one OS process per rank: servers run the PS serve
     loop, clients run T rounds of {pull, push, wait} and report their
     round-loop window; aggregate MB/s uses the union of the client
@@ -803,9 +890,18 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
             MPIT_OBS="1" if obs else "0",
         )
         env.pop("MPIT_OBS_TRACE", None)  # tracing implies obs; keep A/B clean
+        env.pop("MPIT_OBS_PROFILE", None)  # profiling implies obs too
         if decomp_out is not None:
             env["MPIT_OBS"] = "1"
             env["MPIT_OBS_TRACE"] = os.path.join(tmpdir, "decomp_trace.json")
+        if profile_out is not None:
+            # CPU-attribution leg (MPIT_BENCH_PROFILE): profiling +
+            # trace export in every child; the parent merges and runs
+            # `obs profile` over the result.
+            env["MPIT_OBS"] = "1"
+            env["MPIT_OBS_PROFILE"] = "1"
+            env["MPIT_OBS_TRACE"] = os.path.join(tmpdir,
+                                                 "profile_trace.json")
         if status_port is not None:
             env["MPIT_OBS_HTTP"] = str(status_port)
         else:
@@ -876,6 +972,10 @@ def _shm_run_procs(size: int, heartbeat: bool = False,
         decomp_out.clear()
         decomp_out.update(_analyze_gang_trace(
             os.path.join(tmpdir, "decomp_trace.json")))
+    if profile_out is not None:
+        profile_out.clear()
+        profile_out.update(_profile_gang_trace(
+            os.path.join(tmpdir, "profile_trace.json")))
     import shutil
 
     shutil.rmtree(tmpdir, ignore_errors=True)
@@ -919,6 +1019,43 @@ def _analyze_gang_trace(base: str) -> dict:
         "join_rate": round(report["ops"]["join_rate"], 4),
         "joined_ops": report["ops"]["joined"],
     }
+
+
+def _profile_gang_trace(base: str) -> dict:
+    """Merge the gang's per-rank trace parts and run the CPU/utilization
+    attribution (obs/profile.py): per-rank core use, pool overlap
+    efficiency and the encode-while-wire fraction — the
+    MPIT_BENCH_PROFILE column's payload.  Fails loudly when the parts
+    or the counter tracks are missing (a fake utilization column must
+    not be captured)."""
+    import glob
+
+    from mpit_tpu.obs import profile as obs_profile
+    from mpit_tpu.obs import trace as obs_trace
+
+    parts = sorted(glob.glob(f"{base}.rank*.json"))
+    if not parts:
+        raise RuntimeError(
+            "MPIT_BENCH_PROFILE leg completed but no trace parts were "
+            "written — the children never exported (fake column)")
+    obs_trace.merge_traces(base, parts)
+    report = obs_profile.analyze_trace(base)
+    if not report["counter_events"]:
+        raise RuntimeError(
+            "MPIT_BENCH_PROFILE leg produced no counter-track samples — "
+            "profiling was not live in the children (fake column)")
+    out = {
+        "counter_events": report["counter_events"],
+        "cpu_util": {rank: round(row["cpu_util"], 3)
+                     for rank, row in report["ranks"].items()},
+    }
+    eff = report.get("pool_overlap_efficiency")
+    if eff is not None:
+        out["pool_overlap_efficiency"] = round(eff, 3)
+    s = report.get("streaming")
+    if s:
+        out["encode_while_wire"] = round(s["fraction"], 3)
+    return out
 
 
 def _throttle_applies(server, mbs: float) -> None:
@@ -1843,6 +1980,14 @@ def main():
         # the row joins the baseline gate — serving scrapes must not
         # cost the record.
         results.append(bench_shm("none", obs=True, status=True))
+    if PROFILE_SWEEP and MODE in ("shm", "both"):
+        # CPU-attribution leg: codec=none with the profiling plane live
+        # in every child (MPIT_OBS_PROFILE + trace export), analyzed by
+        # `obs profile`.  Gate-exempt like the decomp leg: the
+        # per-step thread-clock reads are a measured ~2x tax on a
+        # time-shared 1-core host — the overhead IS the column
+        # (BENCH_r17); the plain codec=none leg above still gates.
+        results.append(bench_shm("none", obs=True, profile=True))
     if DECOMP_SWEEP and MODE in ("shm", "both"):
         # Causal-decomposition leg: traced FLAG_TIMING gang, analyzed;
         # per-phase p50/p99 lands in the row.  Framed wire => excluded
@@ -1897,6 +2042,7 @@ def main():
             r for r in results
             if r.get("codec") == "none" and r["metric"].endswith("_shm")
             and not r.get("skew") and not r.get("decomp")
+            and not r.get("profile")
             and r["value"] < 0.97 * BASELINE
         ]
         if low:

@@ -22,6 +22,7 @@ from typing import Any, Callable, Generator, Optional
 from mpit_tpu.aio.queue import Queue
 from mpit_tpu.obs import flight as _obs_flight
 from mpit_tpu.obs import metrics as _obs_metrics
+from mpit_tpu.obs import profile as _obs_profile
 from mpit_tpu.obs import spans as _obs_spans
 
 # Idle backoff (microseconds) for the wait loops: after a full pass over
@@ -98,7 +99,7 @@ class Task:
     """
 
     __slots__ = ("gen", "name", "state", "result", "error", "on_done",
-                 "t_obs")
+                 "t_obs", "cpu_s")
 
     def __init__(
         self,
@@ -113,6 +114,7 @@ class Task:
         self.error: Optional[BaseException] = None
         self.on_done = on_done
         self.t_obs: Any = None  # span-recorder token (None when disabled)
+        self.cpu_s = 0.0  # on-CPU seconds (profiler-stamped; 0 when off)
 
     def step(self) -> str:
         """Advance the generator one yield.  Returns the new state."""
@@ -155,6 +157,7 @@ class Scheduler:
         # idle accounting below costs one no-op method call.
         self._rec = _obs_spans.get_recorder()
         self._flight = _obs_flight.get_flight()
+        self._prof = _obs_profile.get_profiler()
         self.stall_s = STALL_S if stall_s is None else float(stall_s)
         self._idle_accum = 0.0
         self._stall_dumped = False
@@ -202,6 +205,10 @@ class Scheduler:
             self.ping()
             if usec > 0:
                 time.sleep(usec * 1e-6)
+        if self._prof.enabled:
+            # Counter-track sample (throttled inside the profiler):
+            # run-queue depth + cumulative task CPU + pool utilization.
+            self._prof.sample(len(self.queue))
         progressed = self._completions != done0
         if progressed:
             self._idle_accum = 0.0
@@ -263,17 +270,31 @@ class Scheduler:
         return task.result
 
     def _step_and_requeue(self, task: Task) -> None:
-        state = task.step()
+        prof = self._prof
+        if prof.enabled:
+            # Per-task CPU attribution (obs/profile.py): the delta of
+            # the stepping thread's CPU clock across this step belongs
+            # to this task — the task-switch boundary IS the yield.
+            c0 = prof.cpu_now()
+            state = task.step()
+            d = prof.cpu_now() - c0
+            if d > 0:
+                task.cpu_s += d
+            prof.step(task.name, d)
+        else:
+            state = task.step()
         self._m_steps.inc()
         if state == EXEC:
             self.queue.push(task)
         elif state == ERR:
             self._completions += 1
-            self._rec.task_end(task.t_obs, task.name, ERR)
+            self._rec.task_end(task.t_obs, task.name, ERR,
+                               cpu_us=task.cpu_s * 1e6)
             self.errors.append(TaskError(task, task.error))  # type: ignore[arg-type]
         elif state == DONE:
             self._completions += 1
-            self._rec.task_end(task.t_obs, task.name, DONE)
+            self._rec.task_end(task.t_obs, task.name, DONE,
+                               cpu_us=task.cpu_s * 1e6)
 
     def __len__(self) -> int:
         return len(self.queue)

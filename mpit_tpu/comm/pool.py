@@ -470,6 +470,13 @@ def get_pool() -> WorkerPool:
         return _GLOBAL
 
 
+def current_pool() -> Optional[WorkerPool]:
+    """The process-wide pool *if one exists* — the observe-only
+    accessor the obs samplers use (obs/profile.py): a profiler reading
+    utilization must never be the thing that spins worker threads up."""
+    return _GLOBAL
+
+
 def configure(threads: Optional[int]) -> WorkerPool:
     """Replace the process-wide pool (tests, bench A/B legs).  Closes
     the previous one so its workers never leak across configurations."""

@@ -48,6 +48,13 @@ counters.  This package is the one place the stack reports through:
   op's latency onto the encode → send-queue → wire → server-queue →
   apply → ack-wire → client-wait taxonomy, reports per-phase
   percentiles and the critical path, and emits Perfetto flow arrows.
+- :mod:`mpit_tpu.obs.profile` — the **CPU/utilization attribution
+  plane** (``MPIT_OBS_PROFILE=1``): per-task ``time.thread_time()``
+  accounting stamped by the cooperative scheduler, ``cpu_us`` riders
+  on op spans and their phases, Chrome counter tracks (pool_util /
+  pool_depth / sched_runq / task_cpu) sampled into the trace, and
+  ``python -m mpit_tpu.obs profile`` — per-rank core utilization,
+  on/off-CPU phase split, pool overlap efficiency, top tasks by CPU.
 
 Enablement: ``MPIT_OBS=1`` (or ``MPIT_OBS_TRACE=<path>``, which implies
 it) turns the global registry + recorder on; :func:`configure` does the
@@ -74,6 +81,13 @@ from mpit_tpu.obs.metrics import (
     get_registry,
     obs_enabled,
     registry_or_local,
+)
+from mpit_tpu.obs.profile import (
+    NULL_PROFILER,
+    Profiler,
+    get_profiler,
+    profile_enabled,
+    resource_snapshot,
 )
 from mpit_tpu.obs.spans import (
     NULL_RECORDER,
@@ -107,4 +121,6 @@ __all__ = [
     "maybe_write_rank_trace", "maybe_merge_rank_traces",
     "PhaseTimers", "trace_annotation", "profiler_trace",
     "ClockEstimator", "PeerClock", "wall_us",
+    "Profiler", "NULL_PROFILER", "get_profiler", "profile_enabled",
+    "resource_snapshot",
 ]

@@ -21,6 +21,12 @@ Subcommands:
   taxonomy and report the critical path (obs/causal.py).  Exit 1 on
   negative phase durations beyond clock uncertainty or a join rate
   below ``--min-join`` — the CI obs-trace job gates on both.
+- ``profile <trace.json> [--json] [--top N] [--require-counters]`` —
+  CPU/utilization attribution (obs/profile.py): per-rank core use,
+  the on/off-CPU split of every marked phase, pool overlap efficiency
+  (busy-seconds ÷ wall × threads), encode-while-wire fraction, and the
+  top tasks by CPU.  ``--require-counters`` exits 1 unless the trace
+  carries ``ph:"C"`` counter-track samples (the CI profile-smoke gate).
 """
 
 import glob as _glob
@@ -93,6 +99,10 @@ def main(argv=None) -> int:
         from mpit_tpu.obs.causal import main as analyze_main
 
         return analyze_main(argv[1:])
+    if argv and argv[0] == "profile":
+        from mpit_tpu.obs.profile import main as profile_main
+
+        return profile_main(argv[1:])
     if argv and argv[0] == "validate":
         argv = argv[1:]
     from mpit_tpu.obs.trace import main as validate_main

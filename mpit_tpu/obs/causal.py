@@ -69,7 +69,7 @@ class Span:
     """One reconstructed op span from the trace."""
 
     __slots__ = ("pid", "tid", "name", "t0", "t1", "args", "outcome",
-                 "phases")
+                 "phases", "phase_cpu", "cpu_us")
 
     def __init__(self, pid, tid, name, t0, args):
         self.pid = pid
@@ -81,6 +81,12 @@ class Span:
         self.outcome = ""
         #: [(phase, ts_us, dur_us)] in trace order
         self.phases: List[Tuple[str, float, float]] = []
+        #: per-phase on-CPU µs, aligned with ``phases`` (None for
+        #: entries whose X event carried no cpu rider — profiling off)
+        self.phase_cpu: List[Optional[float]] = []
+        #: span-level on-CPU µs from the E event rider (None when the
+        #: trace predates profiling or it was off)
+        self.cpu_us: Optional[float] = None
 
     @property
     def side(self) -> str:
@@ -126,11 +132,18 @@ def extract_spans(events) -> List[Span]:
                 phase = name.rsplit(".", 1)[-1]
                 span.phases.append((phase, float(ev.get("ts", 0.0)),
                                     float(ev.get("dur", 0.0))))
+                cpu = (ev.get("args") or {}).get("cpu_us")
+                span.phase_cpu.append(
+                    float(cpu) if isinstance(cpu, (int, float)) else None)
         elif ph == "E" and ev.get("cat") == "ps_op":
             span = open_span.pop(key, None)
             if span is not None:
                 span.t1 = float(ev.get("ts", span.t0))
-                span.outcome = str((ev.get("args") or {}).get("outcome", ""))
+                end_args = ev.get("args") or {}
+                span.outcome = str(end_args.get("outcome", ""))
+                cpu = end_args.get("cpu_us")
+                if isinstance(cpu, (int, float)):
+                    span.cpu_us = float(cpu)
                 spans.append(span)
     return spans
 
@@ -451,6 +464,58 @@ def _percentile(sorted_values: List[float], q: float) -> float:
     return sorted_values[idx]
 
 
+# -- CPU attribution (obs/profile.py riders) ---------------------------------
+
+
+def cpu_attribution(spans: List[Span]) -> Optional[dict]:
+    """The on-CPU vs off-CPU split of every marked phase, aggregated
+    per ``op/side`` — the CPU sibling of the wall decomposition.  Uses
+    the ``cpu_us`` riders the trace exporter attaches when profiling
+    ran; same-thread stamps, so no clock alignment enters.  Each row is
+    non-negative and sums to its phase wall by construction: on-CPU is
+    the rider clamped to ``[0, wall]``, off-CPU the remainder (the
+    same clamping discipline as :func:`decompose`).  None when no span
+    carried a rider (profiling was off)."""
+    per: Dict[Tuple[str, str], Dict[str, List[float]]] = {}
+    found = False
+    for span in spans:
+        rows = per.setdefault((span.name, span.side or "?"), {})
+        for (phase, _ts, dur), cpu in zip(span.phases, span.phase_cpu):
+            if cpu is None:
+                continue
+            found = True
+            wall = max(dur, 0.0)
+            on = min(max(cpu, 0.0), wall)
+            acc = rows.setdefault(phase, [0.0, 0.0, 0.0])
+            acc[0] += 1
+            acc[1] += wall
+            acc[2] += on
+        if span.cpu_us is not None:
+            found = True
+            wall = max(span.t1 - span.t0, 0.0)
+            on = min(max(span.cpu_us, 0.0), wall)
+            acc = rows.setdefault("(span)", [0.0, 0.0, 0.0])
+            acc[0] += 1
+            acc[1] += wall
+            acc[2] += on
+    if not found:
+        return None
+    out: Dict[str, dict] = {}
+    for (op, side), rows in sorted(per.items()):
+        if not rows:
+            continue
+        out[f"{op}/{side}"] = {
+            phase: {
+                "count": int(n),
+                "wall_us": wall,
+                "cpu_us": on,
+                "off_cpu_us": wall - on,
+            }
+            for phase, (n, wall, on) in sorted(rows.items())
+        }
+    return out or None
+
+
 # -- streaming overlap (FLAG_CHUNKED, docs/PROTOCOL.md §12) ------------------
 
 
@@ -527,6 +592,9 @@ def analyze(path_or_obj, min_join: float = 0.0) -> dict:
     :func:`main`."""
     events, other = load_trace(path_or_obj)
     spans = extract_spans(events)
+    # CPU attribution covers every span kind (REDUCE hops burn CPU in
+    # their folds too), so it is computed before the REDUCE filter.
+    cpu_section = cpu_attribution(spans)
     # REDUCE spans (§13) are summarized separately — a reduction hop has
     # no server half to join.
     agg_rows = [s for s in spans if s.name == "REDUCE"]
@@ -623,6 +691,7 @@ def analyze(path_or_obj, min_join: float = 0.0) -> dict:
         "critical_path": critical,
         "streaming": streaming,
         "aggregation": aggregation_section(agg_rows),
+        "cpu_attribution": cpu_section,
         "slowest": slowest,
         "violations": violations,
         "chains": decomposed,
@@ -742,6 +811,19 @@ def render_report(report: dict, top: int = 5) -> str:
             f"fold p50 {agg['fold_p50_us'] / 1000.0:.3f}ms, "
             f"late folds {agg['late_folds']}, "
             f"fallbacks {agg['fallbacks']}")
+    cpu = report.get("cpu_attribution")
+    if cpu:
+        lines.append("cpu attribution (on-cpu / wall per marked phase):")
+        for key, rows in cpu.items():
+            parts = []
+            for phase, e in rows.items():
+                if not e["wall_us"]:
+                    continue
+                parts.append(
+                    f"{phase}={e['cpu_us'] / 1000.0:.3f}/"
+                    f"{e['wall_us'] / 1000.0:.3f}ms")
+            if parts:
+                lines.append(f"  {key}: " + "  ".join(parts))
     for d in report["slowest"][:top]:
         decomp = "  ".join(f"{phase}={d['phases'][phase] / 1000.0:.3f}"
                            for phase in PHASES if d["phases"][phase] > 0)

@@ -154,6 +154,11 @@ class FlightRecorder:
             # chain above maps onto a sibling rank's dump/trace.
             "clock": _clock.snapshot_all(),
             "metrics": _metrics.get_registry().snapshot(),
+            # Where the cores were at death (obs/profile.py): native
+            # pool threads/depth/busy, scheduler runq/CPU totals, and
+            # the top tasks by CPU — a stall postmortem names the hog
+            # (pool-only when profiling was off; {} with no pool).
+            "resources": _resource_snapshot(),
         }
         if extra:
             obj["extra"] = extra
@@ -164,6 +169,17 @@ class FlightRecorder:
             return None
         self.last_dump_path = path
         return path
+
+
+def _resource_snapshot() -> Dict[str, object]:
+    """The obs/profile.py resource section; a failing snapshot must
+    never mask the failure the dump reports."""
+    try:
+        from mpit_tpu.obs import profile as _profile
+
+        return _profile.resource_snapshot()
+    except Exception:  # pragma: no cover - defensive postmortem path
+        return {}
 
 
 _GLOBAL: Optional[FlightRecorder] = None
@@ -239,6 +255,40 @@ def validate_dump(path_or_obj) -> Dict[str, object]:
         if reason == "slo_breach" and "breach_for_s" not in extra:
             raise ValueError(
                 "slo_breach dump extra must carry breach_for_s")
+    if reason == "scheduler_stall":
+        # A stall postmortem must say where the cores were: the
+        # resources section (obs/profile.py) with well-formed pool /
+        # scheduler / top-task subsections when present.  Pool-only
+        # (or empty) is legal — profiling may have been off — but a
+        # malformed section would poison every stall triage tool.
+        resources = obj.get("resources")
+        if not isinstance(resources, dict):
+            raise ValueError(
+                "scheduler_stall dump has no resources section (dict "
+                "required; may be empty)")
+        pool = resources.get("pool")
+        if pool is not None and (
+                not isinstance(pool, dict)
+                or not {"threads", "depth", "busy_seconds"} <= set(pool)):
+            raise ValueError(
+                "scheduler_stall dump resources.pool must carry "
+                "threads + depth + busy_seconds")
+        sched = resources.get("sched")
+        if sched is not None and (
+                not isinstance(sched, dict)
+                or not {"runq", "cpu_seconds"} <= set(sched)):
+            raise ValueError(
+                "scheduler_stall dump resources.sched must carry "
+                "runq + cpu_seconds")
+        top = resources.get("top_tasks")
+        if top is not None and (
+                not isinstance(top, list) or any(
+                    not isinstance(row, list) or len(row) != 2
+                    or not isinstance(row[1], (int, float))
+                    for row in top)):
+            raise ValueError(
+                "scheduler_stall dump resources.top_tasks must be "
+                "[name, cpu_us] pairs")
     if reason in ("cell_failover", "cell_lag_shed"):
         # Cell-fabric postmortems (PROTOCOL.md §11): a dead or lagging
         # cell must leave its version window behind — which version was

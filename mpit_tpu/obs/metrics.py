@@ -34,6 +34,7 @@ from typing import Dict, Optional, Tuple
 ENV = "MPIT_OBS"
 TRACE_ENV = "MPIT_OBS_TRACE"
 HTTP_ENV = "MPIT_OBS_HTTP"
+PROFILE_ENV = "MPIT_OBS_PROFILE"
 
 #: log2 histogram layout (see module docstring).
 HIST_LO_EXP = -20
@@ -333,12 +334,16 @@ _FORCED: Optional[bool] = None
 def obs_enabled() -> bool:
     """True when the global registry/recorder should be live: forced via
     :func:`configure`, ``MPIT_OBS`` truthy, ``MPIT_OBS_TRACE`` set (a
-    trace request implies spans, which imply metrics), or
+    trace request implies spans, which imply metrics),
     ``MPIT_OBS_HTTP`` set (a live introspection endpoint serving an
-    empty registry would be a lie)."""
+    empty registry would be a lie), or ``MPIT_OBS_PROFILE`` truthy (a
+    CPU-attribution request implies the spans/metrics it annotates —
+    obs/profile.py; the reverse implication does not hold)."""
     if _FORCED is not None:
         return _FORCED
     if os.environ.get(ENV, "") not in ("", "0"):
+        return True
+    if os.environ.get(PROFILE_ENV, "") not in ("", "0"):
         return True
     return bool(os.environ.get(TRACE_ENV, "")
                 or os.environ.get(HTTP_ENV, ""))
@@ -373,9 +378,10 @@ def configure(enabled: Optional[bool] = None, reset: bool = False) -> None:
     _FORCED = enabled
     if reset:
         _GLOBAL = Registry()
-        from mpit_tpu.obs import clock, flight, spans, statusd
+        from mpit_tpu.obs import clock, flight, profile, spans, statusd
 
         spans.reset()
         flight.reset()
         statusd.clear_providers()
         clock.reset()
+        profile.reset()

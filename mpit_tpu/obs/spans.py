@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 from mpit_tpu.obs import clock as _clock
 from mpit_tpu.obs import flight as _flight
 from mpit_tpu.obs import metrics as _metrics
+from mpit_tpu.obs import profile as _profile
 
 
 class NullSpan:
@@ -59,7 +60,7 @@ NULL_SPAN = NullSpan()
 
 class OpSpan:
     __slots__ = ("_rec", "name", "tid", "t0", "t1", "marks", "args",
-                 "outcome")
+                 "outcome", "cpu0", "cpu1", "cpu_marks", "cpu_us")
 
     def __init__(self, rec: "SpanRecorder", name: str, tid: str,
                  args: Dict[str, object]):
@@ -71,11 +72,22 @@ class OpSpan:
         self.marks: List[Tuple[str, float]] = []
         self.args = args
         self.outcome = ""
+        # CPU attribution (obs/profile.py): when profiling is enabled
+        # the span stamps the stepping thread's CPU clock alongside
+        # every wall stamp, so the exporter can split each phase into
+        # on-cpu vs off-cpu.  Off (cpu0 None): zero extra clock reads.
+        self.cpu0: Optional[float] = (
+            rec._prof.cpu_now() if rec._prof.enabled else None)
+        self.cpu1: float = 0.0
+        self.cpu_marks: List[float] = []
+        self.cpu_us: Optional[float] = None
 
     def mark(self, phase: str) -> None:
         """Phase ``phase`` begins now (it runs until the next mark or
         the end of the span)."""
         self.marks.append((phase, time.monotonic()))
+        if self.cpu0 is not None:
+            self.cpu_marks.append(self._rec._prof.cpu_now())
 
     def note(self, **kw) -> None:
         """Attach args discovered mid-op (e.g. seq assigned after the
@@ -86,6 +98,9 @@ class OpSpan:
         if self.t1 is not None:
             return  # idempotent: error paths may end defensively
         self.t1 = time.monotonic()
+        if self.cpu0 is not None:
+            self.cpu1 = self._rec._prof.cpu_now()
+            self.cpu_us = max((self.cpu1 - self.cpu0) * 1e6, 0.0)
         self.outcome = outcome
         if kw:
             self.args.update(kw)
@@ -102,7 +117,13 @@ class SpanRecorder:
         self.registry = registry if registry is not None \
             else _metrics.get_registry()
         self.spans: List[OpSpan] = []
-        self.tasks: List[Tuple[str, float, float, str]] = []
+        #: (name, t0, t1, state, cpu_us) — cpu_us is 0.0 unless the
+        #: profiler was live (obs/profile.py) and the scheduler fed
+        #: the task's accumulated thread-time through task_end.
+        self.tasks: List[Tuple[str, float, float, str, float]] = []
+        #: the CPU clock source for op spans — the null profiler when
+        #: profiling is off, so spans stamp no thread-time by default.
+        self._prof = _profile.get_profiler()
         #: monotonic -> wall offset for cross-rank trace merging — the
         #: process-wide time base (obs/clock.py), shared with the flight
         #: recorder and the FLAG_TIMING wire stamps so every timestamp
@@ -178,11 +199,12 @@ class SpanRecorder:
     def task_begin(self, name: str) -> float:
         return time.monotonic()
 
-    def task_end(self, token: Optional[float], name: str, state: str) -> None:
+    def task_end(self, token: Optional[float], name: str, state: str,
+                 cpu_us: float = 0.0) -> None:
         if token is None:
             return  # task spawned while recording was disabled
         now = time.monotonic()
-        self.tasks.append((name, token, now, state))
+        self.tasks.append((name, token, now, state, cpu_us))
         self.flight.record("task", name=name, state=state,
                            dur_s=now - token, t0=token)
 
@@ -206,7 +228,8 @@ class NullRecorder:
     def task_begin(self, name: str) -> None:
         return None
 
-    def task_end(self, token, name: str, state: str) -> None:
+    def task_end(self, token, name: str, state: str,
+                 cpu_us: float = 0.0) -> None:
         pass
 
 

@@ -42,6 +42,7 @@ from typing import Callable, Dict, Optional
 
 from mpit_tpu.obs import clock as _clock
 from mpit_tpu.obs import metrics as _metrics
+from mpit_tpu.obs import profile as _profile
 from mpit_tpu.obs import spans as _spans
 
 ENV = _metrics.HTTP_ENV  # MPIT_OBS_HTTP
@@ -163,6 +164,10 @@ class StatusServer:
             "obs": _metrics.obs_enabled(),
             "inflight_ops": rec.open_ops(),
             "clock": _clock.snapshot_all(),
+            # Where the cores are right now (obs/profile.py): pool
+            # threads/depth/busy, scheduler runq/CPU, top-5 tasks by
+            # cpu_us.  Pool-only when profiling is off.
+            "resources": _profile.resource_snapshot(),
             **_provider_sections(),
         }
 

@@ -229,6 +229,11 @@ def _rank_row(rank: int, sample: Optional[dict],
         # Pooled data plane (comm/pool.py): chunk kernels dispatched to
         # the native worker pool — 0 on serial-fallback ranks.
         "pool_jobs": int(metric_sum(m, "mpit_pool_jobs_total")),
+        # CPU attribution plane (obs/profile.py): scheduler run-queue
+        # depth; cpu%/pool-util% are windowed below (None first poll).
+        "sched_runq": int(metric_sum(m, "mpit_sched_runq")),
+        "cpu_pct": None,
+        "pool_util": None,
     }
     # SLO columns (ISSUE 11): BUSY-reply ratio (admission rejections
     # over ops — windowed against the previous refresh when one exists)
@@ -261,6 +266,19 @@ def _rank_row(rank: int, sample: Optional[dict],
                     + metric_sum(prev["metrics"],
                                  "mpit_ps_params_served_total"))
         row["ops_per_s"] = (ops - prev_ops) / dt
+        # Windowed core use (obs/profile.py): Δ scheduler-attributed
+        # CPU seconds per wall second (fraction of one core), and Δ
+        # pool busy-seconds over the window's thread-capacity.
+        pm = prev["metrics"]
+        d_cpu = (metric_sum(m, "mpit_sched_cpu_seconds_total")
+                 - metric_sum(pm, "mpit_sched_cpu_seconds_total"))
+        if d_cpu > 0 or metric_sum(m, "mpit_sched_cpu_seconds_total") > 0:
+            row["cpu_pct"] = max(d_cpu, 0.0) / dt * 100.0
+        threads = metric_sum(m, "mpit_pool_threads")
+        if threads > 0:
+            d_busy = (metric_sum(m, "mpit_pool_busy_seconds")
+                      - metric_sum(pm, "mpit_pool_busy_seconds"))
+            row["pool_util"] = max(d_busy, 0.0) / (dt * threads) * 100.0
     return row
 
 
@@ -300,7 +318,7 @@ _COLUMNS = ("rank", "role", "ops", "ops/s", "p99ms", "slo", "busy%",
             "sendq", "conns",
             "busy", "stale", "retry", "evict", "shards", "busy_s", "mapv",
             "gang", "cellv", "lag", "rdrs", "rrt", "fanin", "late", "fb",
-            "pool", "infl")
+            "pool", "cpu%", "putl%", "runq", "infl")
 
 
 def render_table(rows: List[Dict[str, object]]) -> str:
@@ -345,6 +363,15 @@ def render_table(rows: List[Dict[str, object]]) -> str:
             # Worker-pool column: pooled kernel jobs dispatched —
             # serial-fallback ranks show '-'.
             str(row["pool_jobs"]) if row.get("pool_jobs") else "-",
+            # CPU attribution columns (obs/profile.py): windowed
+            # scheduler CPU (% of one core), windowed pool utilization
+            # (% of thread capacity), current run-queue depth — all
+            # '-' unless profiling is on and a window exists.
+            (f"{row['cpu_pct']:.0f}" if row.get("cpu_pct") is not None
+             else "-"),
+            (f"{row['pool_util']:.0f}" if row.get("pool_util") is not None
+             else "-"),
+            str(row["sched_runq"]) if row.get("sched_runq") else "-",
             str(row["inflight"]),
         ]
 

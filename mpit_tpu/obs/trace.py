@@ -34,13 +34,17 @@ from typing import Dict, List, Optional
 
 from mpit_tpu.obs import clock as _clock
 from mpit_tpu.obs import metrics as _metrics
+from mpit_tpu.obs import profile as _profile
 from mpit_tpu.obs import spans as _spans
 
 ENV = _metrics.TRACE_ENV  # MPIT_OBS_TRACE
 
 
-def chrome_events(recorder, pid: int, label: str = "") -> List[dict]:
-    """Flatten one recorder into trace events for process ``pid``."""
+def chrome_events(recorder, pid: int, label: str = "",
+                  profiler=None) -> List[dict]:
+    """Flatten one recorder (plus the profiler's counter-track samples,
+    when profiling ran — obs/profile.py) into trace events for process
+    ``pid``."""
     events: List[dict] = [{
         "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
         "args": {"name": label or f"rank {pid}"},
@@ -70,22 +74,47 @@ def chrome_events(recorder, pid: int, label: str = "") -> List[dict]:
             "args": {k: v for k, v in sp.args.items()},
         })
         marks = sp.marks
+        # CPU attribution rider: when the span stamped the CPU clock
+        # alongside its wall marks (profiling on), each phase X event
+        # carries its on-CPU share and the E carries the span total.
+        cpu_stamps = None
+        if sp.cpu0 is not None and len(sp.cpu_marks) == len(marks):
+            cpu_stamps = list(sp.cpu_marks) + [sp.cpu1]
         for i, (phase, mt) in enumerate(marks):
             end = marks[i + 1][1] if i + 1 < len(marks) else sp.t1
-            events.append({
+            ev = {
                 "ph": "X", "name": f"{sp.name}.{phase}", "cat": "ps_phase",
                 "pid": pid, "tid": t, "ts": us(mt),
                 "dur": max((end - mt) * 1e6, 0.0),
-            })
+            }
+            if cpu_stamps is not None:
+                ev["args"] = {"cpu_us": max(
+                    (cpu_stamps[i + 1] - cpu_stamps[i]) * 1e6, 0.0)}
+            events.append(ev)
+        end_args: Dict[str, object] = {"outcome": sp.outcome}
+        if sp.cpu_us is not None:
+            end_args["cpu_us"] = sp.cpu_us
         events.append({
             "ph": "E", "name": sp.name, "cat": "ps_op", "pid": pid,
-            "tid": t, "ts": us(sp.t1), "args": {"outcome": sp.outcome},
+            "tid": t, "ts": us(sp.t1), "args": end_args,
         })
-    for name, t0, t1, state in list(recorder.tasks):
+    for name, t0, t1, state, cpu_us in list(recorder.tasks):
+        args: Dict[str, object] = {"state": state}
+        if cpu_us:
+            args["cpu_us"] = cpu_us
         events.append({
             "ph": "X", "name": name, "cat": "task", "pid": pid,
             "tid": tid_of(f"task:{name}"), "ts": us(t0),
-            "dur": max((t1 - t0) * 1e6, 0.0), "args": {"state": state},
+            "dur": max((t1 - t0) * 1e6, 0.0), "args": args,
+        })
+    # Counter tracks (ph:"C"): the profiler's sampled pool/scheduler
+    # utilization series.  Chrome keys counters by (pid, name), so the
+    # same four track names stay distinct per rank after a merge.
+    prof = profiler if profiler is not None else _profile.get_profiler()
+    for ts_mono, track, value in list(prof.samples):
+        events.append({
+            "ph": "C", "name": track, "cat": "resource", "pid": pid,
+            "tid": 0, "ts": us(ts_mono), "args": {"value": value},
         })
     # Stable sort on ts only: a span's B was appended before its E, so
     # equal timestamps (zero-length spans) keep begin-before-end order.
@@ -189,7 +218,7 @@ def validate_trace(path_or_obj) -> Dict[str, object]:
         raise ValueError("trace is neither an event array nor an object "
                          "with a traceEvents list")
     stacks: Dict[tuple, List[str]] = {}
-    pids, ops, tasks = set(), 0, 0
+    pids, ops, tasks, counters = set(), 0, 0, 0
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
             raise ValueError(f"event {i} is not an object")
@@ -200,7 +229,15 @@ def validate_trace(path_or_obj) -> Dict[str, object]:
         pids.add(ev["pid"])
         if ph != "M" and not isinstance(ev.get("ts"), (int, float)):
             raise ValueError(f"event {i} ({ev['name']!r}) has no numeric ts")
-        if ph == "X":
+        if ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not isinstance(
+                    args.get("value"), (int, float)):
+                raise ValueError(
+                    f"event {i} ({ev['name']!r}) C without numeric "
+                    "args.value")
+            counters += 1
+        elif ph == "X":
             if not isinstance(ev.get("dur"), (int, float)) or ev["dur"] < 0:
                 raise ValueError(
                     f"event {i} ({ev['name']!r}) X without dur >= 0")
@@ -224,7 +261,7 @@ def validate_trace(path_or_obj) -> Dict[str, object]:
     if unbalanced:
         raise ValueError(f"unclosed B spans at EOF: {unbalanced}")
     return {"events": len(events), "pids": len(pids), "ops": ops,
-            "tasks": tasks}
+            "tasks": tasks, "counters": counters}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -244,7 +281,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             continue
         print(f"{path}: ok — {stats['events']} events, "
               f"{stats['pids']} rank(s), {stats['ops']} op span(s), "
-              f"{stats['tasks']} task(s)")
+              f"{stats['tasks']} task(s), "
+              f"{stats['counters']} counter sample(s)")
     return rc
 
 

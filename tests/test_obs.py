@@ -30,6 +30,7 @@ from mpit_tpu.comm.local import LocalRouter
 from mpit_tpu.ft import FaultPlan, FaultyTransport, FTConfig, RetryExhausted
 from mpit_tpu.obs import flight as obs_flight
 from mpit_tpu.obs import metrics as obs_metrics
+from mpit_tpu.obs import profile as obs_profile
 from mpit_tpu.obs import spans as obs_spans
 from mpit_tpu.obs import statusd as obs_statusd
 from mpit_tpu.obs import top as obs_top
@@ -152,6 +153,16 @@ class TestDisabledPath:
         assert fl is obs_flight.NULL_FLIGHT
         fl.record("op", name="GRAD")
         assert fl.dump("anything") is None and fl.events == ()
+        # the CPU profiler is the shared null object too: no clock
+        # reads, no samples, nothing to snapshot
+        prof = obs_profile.get_profiler()
+        assert prof is obs_profile.NULL_PROFILER
+        assert not prof.enabled
+        assert prof.cpu_now() == 0.0
+        prof.step("t", 0.5)
+        prof.sample(3)
+        assert prof.samples == () and prof.cpu_seconds == 0.0
+        assert prof.top_tasks() == []
         # and no statusd endpoint (no socket) without MPIT_OBS_HTTP
         assert obs_statusd.maybe_start(0) is None
         # nothing accumulates anywhere
@@ -163,15 +174,17 @@ class TestDisabledPath:
     def test_disabled_path_microbenchmark(self):
         """The no-op-object claim, measured: 200k disabled counter incs
         plus 20k disabled op-span lifecycles plus 20k disabled
-        flight-recorder records must finish far inside a generous
-        absolute budget (>= 5 µs/op would still pass — real cost is
-        tens of ns).  Catches anyone replacing the null objects — the
-        registry's, the span recorder's, or the new flight recorder's —
-        with env reads or clock calls per operation."""
+        flight-recorder records plus 20k disabled profiler step/sample
+        pairs must finish far inside a generous absolute budget
+        (>= 5 µs/op would still pass — real cost is tens of ns).
+        Catches anyone replacing the null objects — the registry's,
+        the span recorder's, the flight recorder's, or the CPU
+        profiler's — with env reads or clock calls per operation."""
         reg = obs.get_registry()
         c = reg.counter("mpit_bench_total")
         rec = obs_spans.get_recorder()
         fl = obs_flight.get_flight()
+        prof = obs_profile.get_profiler()
         t0 = time.perf_counter()
         for _ in range(200_000):
             c.inc()
@@ -181,9 +194,12 @@ class TestDisabledPath:
             sp.end("ok")
         for _ in range(20_000):
             fl.record("op", name="GRAD", outcome="ok")
+        for _ in range(20_000):
+            prof.step("t", prof.cpu_now())
+            prof.sample(0)
         elapsed = time.perf_counter() - t0
         assert elapsed < 1.2, (
-            f"disabled-path overhead {elapsed:.3f}s for 240k ops — the "
+            f"disabled-path overhead {elapsed:.3f}s for 260k ops — the "
             "null objects are no longer no-ops")
 
     def test_configure_flips_and_restores(self):
@@ -230,7 +246,7 @@ class TestSpans:
         sched.spawn(aio_sleep(0.01), name="nap")
         sched.wait()
         rec = obs_spans.get_recorder()
-        names = [name for name, _, _, state in rec.tasks]
+        names = [name for name, _, _, state, _cpu in rec.tasks]
         assert "nap" in names
         assert obs_on.counter("mpit_aio_steps_total").value > 0
         assert obs_on.counter("mpit_aio_tasks_total").value >= 1

@@ -1,0 +1,368 @@
+"""CPU/utilization attribution plane (obs/profile.py).
+
+Four layers of assertion:
+
+1. the profiler primitive: step attribution (negative deltas clamped,
+   never negative totals), throttled counter-track sampling, the
+   metric bindings (``mpit_sched_cpu_seconds_total`` /
+   ``mpit_sched_runq``), and the enablement contract — profiling is
+   OFF even when obs is on, and the disabled object is the shared
+   null singleton;
+2. scheduler integration: a CPU-burning task run under profiling
+   carries ``cpu_s`` on the Task, ``cpu_us`` on its recorded
+   lifecycle, and an attribution row in the profiler;
+3. deterministic counter-track round trips: samples written by the
+   trace exporter validate as ``ph:"C"`` events, survive a merge with
+   per-rank (pid) tracks kept distinct, and surface in
+   ``analyze_trace``;
+4. the offline report: cpu attribution is non-negative and
+   sums-to-wall by construction (clamping both directions), and the
+   ``profile`` CLI round-trips --json / --require-counters, while
+   flight dumps for ``scheduler_stall`` carry a well-formed resources
+   section (validate_dump enforces the shape).
+"""
+
+import json
+
+import pytest
+
+from mpit_tpu import obs
+from mpit_tpu.aio import Scheduler
+from mpit_tpu.obs import causal as obs_causal
+from mpit_tpu.obs import flight as obs_flight
+from mpit_tpu.obs import metrics as obs_metrics
+from mpit_tpu.obs import profile as obs_profile
+from mpit_tpu.obs import spans as obs_spans
+from mpit_tpu.obs import trace as obs_trace
+from mpit_tpu.obs.__main__ import main as obs_cli
+
+
+@pytest.fixture
+def prof_on():
+    """obs + profiling forced on, everything reset on the way out.
+    Order matters: obs.configure(reset=True) clears the profile
+    override too, so the profile flip comes second."""
+    obs.configure(enabled=True, reset=True)
+    obs_profile.configure(enabled=True, reset=True)
+    try:
+        yield obs_profile.get_profiler()
+    finally:
+        obs.configure(enabled=None, reset=True)
+
+
+def burn_task(rounds=40, width=4000):
+    """A generator task that does real arithmetic per step — enough
+    thread-time to stamp, few enough steps to stay fast."""
+    acc = 0
+    for _ in range(rounds):
+        acc += sum(i * i for i in range(width))
+        yield
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# the profiler primitive + enablement
+
+
+class TestProfilerPrimitive:
+    def test_profiling_off_even_when_obs_on(self):
+        obs.configure(enabled=True, reset=True)
+        try:
+            assert obs.obs_enabled()
+            assert not obs_profile.profile_enabled()
+            assert obs_profile.get_profiler() is obs_profile.NULL_PROFILER
+        finally:
+            obs.configure(enabled=None, reset=True)
+
+    def test_env_enablement_implies_obs(self, monkeypatch):
+        monkeypatch.setenv(obs_profile.PROFILE_ENV, "1")
+        # MPIT_OBS_PROFILE alone turns obs on (like a trace request)
+        assert obs_metrics.obs_enabled()
+        assert obs_profile.profile_enabled()
+        monkeypatch.setenv(obs_profile.PROFILE_ENV, "0")
+        assert not obs_profile.profile_enabled()
+
+    def test_step_attributes_and_counts(self, prof_on):
+        prof = prof_on
+        prof.step("apply", 0.010)
+        prof.step("apply", 0.005)
+        prof.step("encode", 0.002)
+        prof.step("noise", -0.5)  # foreign-thread stamp: dropped
+        prof.step("noise", 0.0)
+        assert prof.task_cpu["apply"] == pytest.approx(0.015)
+        assert "noise" not in prof.task_cpu
+        assert prof.cpu_seconds == pytest.approx(0.017)
+        reg = obs.get_registry()
+        c = reg.counter("mpit_sched_cpu_seconds_total")
+        assert c.value == pytest.approx(0.017)
+        top = prof.top_tasks(1)
+        assert top == [["apply", pytest.approx(15000.0)]]
+
+    def test_sample_emits_tracks_and_throttles(self, prof_on):
+        prof = prof_on
+        prof._interval = 0.0  # deterministic: no rate cap
+        prof.step("t", 0.001)
+        prof.sample(3)
+        tracks = {track for _, track, _ in prof.samples}
+        # no pool in this process path — the scheduler tracks only
+        assert {"sched_runq", "task_cpu"} <= tracks
+        assert prof.last_runq == 3
+        g = obs.get_registry().gauge("mpit_sched_runq")
+        assert g.value == 3
+        # throttle: a huge interval means the next call is a no-op
+        n = len(prof.samples)
+        prof._interval = 3600.0
+        prof.sample(9)
+        assert len(prof.samples) == n and prof.last_runq == 3
+
+    def test_cpu_now_is_a_real_clock(self, prof_on):
+        t0 = prof_on.cpu_now()
+        sum(i * i for i in range(50_000))
+        assert prof_on.cpu_now() >= t0
+
+    def test_resource_snapshot_sections(self, prof_on):
+        prof_on.step("hot", 0.004)
+        prof_on._interval = 0.0
+        prof_on.sample(2)
+        snap = obs_profile.resource_snapshot()
+        assert snap["sched"] == {"runq": 2,
+                                 "cpu_seconds": pytest.approx(0.004)}
+        assert ["hot", pytest.approx(4000.0)] in snap["top_tasks"]
+        obs.configure(enabled=None, reset=True)
+        # disabled: no sched/top sections (pool may exist from other
+        # tests — pool-only is legal, so only assert the absence)
+        snap = obs_profile.resource_snapshot()
+        assert "sched" not in snap and "top_tasks" not in snap
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration
+
+
+class TestSchedulerStamping:
+    def test_tasks_carry_cpu(self, prof_on):
+        prof = prof_on
+        prof._interval = 0.0
+        sched = Scheduler(idle_usec=0)
+        sched.spawn(burn_task(), name="burn")
+        sched.wait()
+        assert prof.task_cpu.get("burn", 0.0) > 0.0
+        assert prof.cpu_seconds > 0.0
+        rec = obs_spans.get_recorder()
+        rows = {name: cpu for name, _, _, _, cpu in rec.tasks}
+        assert rows["burn"] > 0.0
+        # the ping pass sampled the run queue at least once
+        assert any(track == "sched_runq" for _, track, _ in prof.samples)
+
+    def test_disabled_scheduler_stamps_nothing(self):
+        obs.configure(enabled=True, reset=True)  # obs on, profiling off
+        try:
+            sched = Scheduler(idle_usec=0)
+            sched.spawn(burn_task(rounds=3), name="burn")
+            sched.wait()
+            rec = obs_spans.get_recorder()
+            rows = {name: cpu for name, _, _, _, cpu in rec.tasks}
+            assert rows["burn"] == 0.0
+        finally:
+            obs.configure(enabled=None, reset=True)
+
+
+# ---------------------------------------------------------------------------
+# counter-track round trips
+
+
+def _sampled_trace(tmp_path, prof, rank, n=4):
+    """Write one rank's trace after n deterministic samples."""
+    prof._interval = 0.0
+    for i in range(n):
+        prof.step(f"task{rank}", 0.001)
+        prof.sample(i)
+    path = str(tmp_path / f"trace.rank{rank}.json")
+    obs_trace.write_rank_trace(path, rank=rank, role="server")
+    return path
+
+
+class TestCounterTracks:
+    def test_round_trip_validates(self, prof_on, tmp_path):
+        path = _sampled_trace(tmp_path, prof_on, rank=0)
+        stats = obs_trace.validate_trace(path)
+        assert stats["counters"] >= 8  # 2 tracks x 4 samples
+        with open(path) as fh:
+            events = json.load(fh)["traceEvents"]
+        cs = [ev for ev in events if ev.get("ph") == "C"]
+        assert cs and all(ev["cat"] == "resource" and ev["tid"] == 0
+                          and isinstance(ev["args"]["value"], (int, float))
+                          for ev in cs)
+        assert {ev["name"] for ev in cs} == {"sched_runq", "task_cpu"}
+
+    def test_malformed_counter_rejected(self, prof_on, tmp_path):
+        path = _sampled_trace(tmp_path, prof_on, rank=0)
+        with open(path) as fh:
+            obj = json.load(fh)
+        for ev in obj["traceEvents"]:
+            if ev.get("ph") == "C":
+                ev["args"] = {}  # strip the value
+                break
+        with pytest.raises(ValueError, match="without numeric args.value"):
+            obs_trace.validate_trace(obj)
+
+    def test_merge_keeps_per_rank_tracks_distinct(self, prof_on, tmp_path):
+        p0 = _sampled_trace(tmp_path, prof_on, rank=0)
+        p1 = _sampled_trace(tmp_path, prof_on, rank=1)
+        merged = str(tmp_path / "trace.json")
+        obs_trace.merge_traces(merged, [p0, p1])
+        assert obs_trace.validate_trace(merged)["counters"] > 0
+        with open(merged) as fh:
+            events = json.load(fh)["traceEvents"]
+        by_pid = {}
+        for ev in events:
+            if ev.get("ph") == "C":
+                by_pid.setdefault(ev["pid"], set()).add(ev["name"])
+        # counters are keyed per pid: both ranks keep their own tracks
+        assert set(by_pid) == {0, 1}
+        assert all("sched_runq" in tracks for tracks in by_pid.values())
+        report = obs_profile.analyze_trace(merged)
+        assert report["counter_events"] > 0
+        assert report["ranks"]["0"]["counter_samples"]["task_cpu"] >= 4
+
+
+# ---------------------------------------------------------------------------
+# cpu attribution math (non-negative, sums-to-wall by construction)
+
+
+def _synthetic_span_events(cpu_encode, cpu_span):
+    """One client GRAD span: 100us encode phase + 300us total wall,
+    with the given cpu riders (possibly out of range — the clamp is
+    the thing under test)."""
+    return [
+        {"ph": "B", "cat": "ps_op", "name": "GRAD", "pid": 0, "tid": 1,
+         "ts": 1000.0, "args": {"side": "client", "peer": 1}},
+        {"ph": "X", "cat": "ps_phase", "name": "GRAD.encode", "pid": 0,
+         "tid": 1, "ts": 1000.0, "dur": 100.0,
+         "args": {"cpu_us": cpu_encode}},
+        {"ph": "E", "cat": "ps_op", "name": "GRAD", "pid": 0, "tid": 1,
+         "ts": 1300.0, "args": {"outcome": "ok", "cpu_us": cpu_span}},
+    ]
+
+
+class TestCpuAttribution:
+    @pytest.mark.parametrize("cpu_encode,cpu_span", [
+        (40.0, 250.0),     # in range
+        (500.0, 900.0),    # rider above wall: clamps to wall
+        (-30.0, -1.0),     # negative rider: clamps to zero
+    ])
+    def test_non_negative_and_sums_to_wall(self, cpu_encode, cpu_span):
+        spans = obs_causal.extract_spans(
+            _synthetic_span_events(cpu_encode, cpu_span))
+        attr = obs_causal.cpu_attribution(spans)
+        rows = attr["GRAD/client"]
+        for row in rows.values():
+            assert row["cpu_us"] >= 0.0 and row["off_cpu_us"] >= 0.0
+            assert row["cpu_us"] + row["off_cpu_us"] == \
+                pytest.approx(row["wall_us"])
+        assert rows["encode"]["wall_us"] == pytest.approx(100.0)
+        assert rows["encode"]["cpu_us"] == \
+            pytest.approx(min(max(cpu_encode, 0.0), 100.0))
+        assert rows["(span)"]["wall_us"] == pytest.approx(300.0)
+        assert rows["(span)"]["cpu_us"] == \
+            pytest.approx(min(max(cpu_span, 0.0), 300.0))
+
+    def test_no_riders_means_none(self):
+        events = _synthetic_span_events(10.0, 20.0)
+        for ev in events:
+            ev.get("args", {}).pop("cpu_us", None)
+        spans = obs_causal.extract_spans(events)
+        assert obs_causal.cpu_attribution(spans) is None
+
+    def test_analyze_trace_ops_table(self):
+        trace = {"traceEvents": _synthetic_span_events(40.0, 250.0),
+                 "otherData": {}}
+        report = obs_profile.analyze_trace(trace)
+        op = report["ops"]["GRAD/client"]
+        assert op["count"] == 1
+        assert op["cpu_us"] + op["off_cpu_us"] == \
+            pytest.approx(op["wall_us"])
+        assert report["cpu_phases"]["GRAD/client"]["encode"]["cpu_us"] == \
+            pytest.approx(40.0)
+
+
+# ---------------------------------------------------------------------------
+# the profile CLI
+
+
+class TestProfileCLI:
+    def test_report_and_json(self, prof_on, tmp_path, capsys):
+        rec = obs_spans.get_recorder()
+        sp = rec.op("GRAD", peer=1, side="client", epoch=0)
+        sp.mark("encode")
+        sp.end("ok")
+        path = _sampled_trace(tmp_path, prof_on, rank=0)
+        assert obs_cli(["profile", path, "--require-counters"]) == 0
+        out = capsys.readouterr().out
+        assert "counter sample" in out and "rank 0" in out
+        assert obs_cli(["profile", path, "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["counter_events"] >= 8
+        assert "GRAD/client" in report["ops"]
+
+    def test_require_counters_gates(self, tmp_path, capsys):
+        obs.configure(enabled=True, reset=True)  # profiling OFF
+        try:
+            path = str(tmp_path / "bare.json")
+            obs_trace.write_rank_trace(path, rank=0)
+        finally:
+            obs.configure(enabled=None, reset=True)
+        assert obs_cli(["profile", path]) == 0
+        capsys.readouterr()
+        assert obs_cli(["profile", path, "--require-counters"]) == 1
+
+    def test_unreadable_trace_is_rc2(self, tmp_path):
+        assert obs_cli(["profile", str(tmp_path / "missing.json")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# flight-dump resources section
+
+
+class TestFlightResources:
+    def test_stall_dump_carries_resources(self, prof_on, tmp_path,
+                                          monkeypatch):
+        monkeypatch.setenv(obs_flight.ENV_DIR, str(tmp_path))
+        prof_on.step("stuck", 0.003)
+        prof_on._interval = 0.0
+        prof_on.sample(1)
+        fl = obs_flight.get_flight()
+        fl.record("task", name="stuck", state="RUNNING")
+        path = fl.dump("scheduler_stall")
+        assert obs_flight.validate_dump(path)["reason"] == "scheduler_stall"
+        with open(path) as fh:
+            obj = json.load(fh)
+        assert obj["resources"]["sched"]["runq"] == 1
+        assert obj["resources"]["top_tasks"][0][0] == "stuck"
+
+    def test_validator_enforces_shape(self, prof_on, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs_flight.ENV_DIR, str(tmp_path))
+        path = obs_flight.get_flight().dump("scheduler_stall")
+        with open(path) as fh:
+            good = json.load(fh)
+        bad = dict(good)
+        bad.pop("resources")
+        with pytest.raises(ValueError, match="no resources section"):
+            obs_flight.validate_dump(bad)
+        bad = json.loads(json.dumps(good))
+        bad["resources"]["pool"] = {"threads": 4}  # missing depth/busy
+        with pytest.raises(ValueError, match="resources.pool"):
+            obs_flight.validate_dump(bad)
+        bad = json.loads(json.dumps(good))
+        bad["resources"]["sched"] = {"runq": 0}  # missing cpu_seconds
+        with pytest.raises(ValueError, match="resources.sched"):
+            obs_flight.validate_dump(bad)
+        bad = json.loads(json.dumps(good))
+        bad["resources"]["top_tasks"] = [["t"]]  # not a [name, cpu] pair
+        with pytest.raises(ValueError, match="top_tasks"):
+            obs_flight.validate_dump(bad)
+        # other reasons never require the section
+        other = json.loads(json.dumps(good))
+        other["reason"] = "retry_exhausted"
+        other.pop("resources")
+        assert obs_flight.validate_dump(other)["reason"] == "retry_exhausted"
