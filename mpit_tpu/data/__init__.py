@@ -2,5 +2,6 @@
 
 from mpit_tpu.data.mnist import load_mnist
 from mpit_tpu.data.qa import QAData, load_qa, synthetic_qa
+from mpit_tpu.data.tokens import doc_batch
 
-__all__ = ["load_mnist", "QAData", "load_qa", "synthetic_qa"]
+__all__ = ["load_mnist", "QAData", "load_qa", "synthetic_qa", "doc_batch"]

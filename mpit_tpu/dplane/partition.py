@@ -237,10 +237,20 @@ def flat_segments(tree: Any, sep: str = "/") -> List[Segment]:
     return segments
 
 
-def aligned_cut(plong: int, segments: Sequence[Segment], n: int):
+def aligned_cut(plong: int, segments: Sequence[Segment], n: int,
+                weights: Optional[Sequence[float]] = None):
     """Cut ``[0, plong)`` into ``n`` contiguous shards whose interior
     boundaries fall on segment boundaries, each as close to the equal
     cut ``i*plong/n`` as the boundaries allow.
+
+    ``weights`` (optional, one positive number per shard) replaces the
+    equal targets with cumulative-fraction targets
+    ``sum(weights[:i]) / sum(weights) * plong`` — the aligned-cut
+    counterpart of :func:`mpit_tpu.ps.sharding.weighted_layout`.  Shard
+    ``i`` lands as close to ``weights[i] / sum(weights)`` of the vector
+    as the parameter boundaries allow; the :mod:`mpit_tpu.lm` plan uses
+    this to equalize *bytes held per server* (params + optimizer slots)
+    when server budgets differ.
 
     Invariants (property-tested): shards tile ``[0, plong)``, every
     shard is nonempty, every interior cut is some segment's offset, and
@@ -253,6 +263,20 @@ def aligned_cut(plong: int, segments: Sequence[Segment], n: int):
 
     if n < 1:
         raise ValueError("need at least one shard")
+    if weights is not None:
+        w = [float(x) for x in weights]
+        if len(w) != n:
+            raise ValueError(f"weights has {len(w)} entries for {n} shards")
+        if any(x <= 0 for x in w):
+            raise ValueError("weights must be positive")
+        total = sum(w)
+        targets = []
+        acc = 0.0
+        for x in w[:-1]:
+            acc += x
+            targets.append(acc / total * plong)
+    else:
+        targets = [i * plong / n for i in range(1, n)]
     segs = sorted(segments, key=lambda s: s.offset)
     pos = 0
     for s in segs:
@@ -273,7 +297,7 @@ def aligned_cut(plong: int, segments: Sequence[Segment], n: int):
     cuts: List[int] = []
     lo = 0
     for i in range(1, n):
-        target = i * plong / n
+        target = targets[i - 1]
         # Leave enough boundaries for the remaining n-1-i cuts.
         hi = len(boundaries) - (n - 1 - i)
         window = boundaries[lo:hi]
@@ -286,11 +310,14 @@ def aligned_cut(plong: int, segments: Sequence[Segment], n: int):
 
 
 def plan_shard_map(tree: Any, server_ranks: Sequence[int], *,
-                   sep: str = "/", shards_per_server: int = 1):
+                   sep: str = "/", shards_per_server: int = 1,
+                   weights: Optional[Sequence[float]] = None):
     """A version-0 :class:`~mpit_tpu.shardctl.shardmap.ShardMap` whose
     cut is segment-aligned — the partition engine acting as shardctl's
     layout source.  ``shards_per_server`` over-partitions (the §9.1
     elasticity units) while keeping every cut on a parameter boundary.
+    ``weights`` (one per server) skews the cut targets; a server's
+    weight is spread evenly over its ``shards_per_server`` shards.
     Pass the result to ``ParamClient(shard_map=...)``."""
     from mpit_tpu.shardctl.shardmap import ShardMap
 
@@ -300,6 +327,14 @@ def plan_shard_map(tree: Any, server_ranks: Sequence[int], *,
     k = max(int(shards_per_server), 1)
     segments = flat_segments(tree, sep=sep)
     plong = segments[-1].end
-    shards = aligned_cut(plong, segments, len(ranks) * k)
+    cut_weights = None
+    if weights is not None:
+        if len(weights) != len(ranks):
+            raise ValueError(
+                f"weights has {len(list(weights))} entries for "
+                f"{len(ranks)} servers")
+        cut_weights = [float(w) / k for w in weights for _ in range(k)]
+    shards = aligned_cut(plong, segments, len(ranks) * k,
+                         weights=cut_weights)
     owners = [r for r in ranks for _ in range(k)]
     return ShardMap.from_shards(shards, owners)

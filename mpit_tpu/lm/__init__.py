@@ -1,0 +1,32 @@
+"""mpit_tpu.lm — the flagship workload: a sharded transformer LM trained
+through the full parameter-server stack, measured in tokens/second.
+
+The subsystem composes machinery that previously had no workload big
+enough to be load-bearing simultaneously:
+
+- :mod:`mpit_tpu.lm.model` — transformer-LM TrainState over
+  ``models/transformer.TinyDecoder`` + the ``ops/`` attention kernels,
+  flattened to the PS wire vector with per-parameter optimizer slots;
+- :mod:`mpit_tpu.lm.plan` — ``dplane/partition.py`` rules over the
+  params+optimizer pytree, lowered to a weighted **aligned-cut** layout
+  sized so params + optimizer state exceed one server's comfortable
+  footprint (and to a shardctl ShardMap when placement should migrate);
+- :mod:`mpit_tpu.lm.data` — a seeded, bit-reproducible packed token
+  stream (same seed => identical batches, in any process);
+- :mod:`mpit_tpu.lm.trainer` — the async DOWNPOUR/EAMSGD client loop
+  with a ``mpit_lm_tokens_total`` meter; tokens/sec is the headline.
+
+Runbook: docs/WORKLOADS.md.  Launcher entry: ``train/launch.py --lm 1``.
+"""
+
+from mpit_tpu.lm.data import EOS, PackedStream, packed_batch
+from mpit_tpu.lm.model import LmModel, build, train_state_tree
+from mpit_tpu.lm.plan import PARTITION_RULES, LmPlan, audit_rules, plan
+from mpit_tpu.lm.trainer import LM_DEFAULTS, LmTrainer
+
+__all__ = [
+    "EOS", "PackedStream", "packed_batch",
+    "LmModel", "build", "train_state_tree",
+    "PARTITION_RULES", "LmPlan", "audit_rules", "plan",
+    "LM_DEFAULTS", "LmTrainer",
+]

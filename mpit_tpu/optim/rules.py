@@ -239,6 +239,33 @@ _RULES: Dict[str, Tuple[Callable[..., State], Callable[..., Tuple[jnp.ndarray, S
 }
 
 
+#: Per-element optimizer-slot multiplicity of each rule: how many extra
+#: vector-shaped state arrays the server allocates beside a shard (scalar
+#: step counters are free).  This is the footprint model behind
+#: :mod:`mpit_tpu.lm.plan`'s per-server HBM budgeting — a shard of S f32
+#: elements under rule R costs ``(1 + STATE_SLOTS[R]) * 4 * S`` bytes —
+#: and it is pinned against the real ``init`` shapes in
+#: tests/test_optim_rules.py so a new state array cannot silently skew
+#: the plan.
+STATE_SLOTS: Dict[str, int] = {
+    "add": 0,
+    "rmsprop": 3,   # grad_accum, grad_sq_accum, update
+    "adam": 2,      # m, v (t is scalar)
+    "adamax": 2,    # m, u (t is scalar)
+    "adagrad": 1,   # variance (t is scalar)
+    "adadelta": 2,  # variance, acc_delta
+}
+
+
+def state_slots(name: str) -> int:
+    """Vector-shaped state arrays rule ``name`` holds per shard."""
+    try:
+        return STATE_SLOTS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown rule {name!r}; have {sorted(_RULES)}") from None
+
+
 def names() -> Tuple[str, ...]:
     return tuple(_RULES)
 

@@ -129,6 +129,7 @@ class ParamClient:
         shardctl: bool = False,
         controller_rank: Optional[int] = None,
         sc_shards_per_server: int = 1,
+        layout: "Optional[List[Shard]]" = None,
     ):
         self.rank = rank
         self.sranks = list(server_ranks)
@@ -145,6 +146,27 @@ class ParamClient:
         # transferred dedup state.
         self._sc = bool(shardctl or shard_map is not None)
         self.smap = shard_map
+        # Static weighted layout (mpit_tpu.lm flagship path): an explicit
+        # contiguous cut — one Shard per server in rank order — that
+        # replaces the equal-split default at start() WITHOUT turning on
+        # shardctl.  The servers adopt whatever cut the first INIT
+        # announces, so an uneven layout is purely a client-side choice;
+        # crucially ``_sc`` stays False, so chunked streaming, staleness,
+        # timing and the §13 agg tree all still negotiate on.  Every
+        # client and reader of one gang must pass the identical layout
+        # (servers reject mismatched re-announcements).
+        self._layout = list(layout) if layout is not None else None
+        if self._layout is not None:
+            if self._sc:
+                raise ValueError(
+                    "layout= is the static weighted cut; it cannot combine "
+                    "with shardctl/shard_map (which own placement already)"
+                )
+            if len(self._layout) != len(self.sranks):
+                raise ValueError(
+                    f"layout has {len(self._layout)} shards for "
+                    f"{len(self.sranks)} servers (need exactly one each)"
+                )
         self.controller_rank = controller_rank
         # Over-partitioning (§9.1): cut the vector into k shards per
         # launch-time server so elasticity has units to move — a gang
@@ -294,8 +316,20 @@ class ParamClient:
             return
         # Placement is a ShardMap even on the static path: version-0,
         # one equal shard per server in rank order — byte-identical to
-        # the raw shard_layout() cut this call site used to make.
-        self.smap = _shardmap.ShardMap.initial(len(param), self.sranks)
+        # the raw shard_layout() cut this call site used to make.  An
+        # explicit ``layout=`` swaps in its weighted cut here; everything
+        # downstream (chunk plans, codec staging, INIT bodies) is already
+        # per-(srank, shard) and never assumes the shards are equal.
+        if self._layout is not None:
+            if self._layout[-1].end != len(param):
+                raise ValueError(
+                    f"layout covers [0, {self._layout[-1].end}) but the "
+                    f"registered vector has {len(param)} elements"
+                )
+            self.smap = _shardmap.ShardMap.from_shards(self._layout,
+                                                       self.sranks)
+        else:
+            self.smap = _shardmap.ShardMap.initial(len(param), self.sranks)
         self.shards = [e.shard for e in self.smap.entries]
         flags = (FLAG_FRAMED if self.ft.framed else 0) | (
             FLAG_HEARTBEAT if self.ft.heartbeat_s > 0 else 0

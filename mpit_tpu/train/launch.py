@@ -198,6 +198,24 @@ LAUNCH_DEFAULTS = TRAINER_DEFAULTS.merged(
     agg_tree_seed=0,
     agg_deadline_s=5.0,
     agg_chunk_bytes=0,
+    # Flagship LM workload (mpit_tpu.lm; docs/WORKLOADS.md): --lm 1
+    # swaps the MNIST trainer for the sharded transformer-LM loop.  The
+    # shared optimizer knobs (--opt/--lr/--mom/--mva/--su/--batch/
+    # --seed/--dtype) carry over; the lm_* knobs size the model and the
+    # step loop.  Unless shardctl owns placement, every client AND
+    # reader announces the same weighted aligned-cut layout
+    # (mpit_tpu.lm.plan over the params+optimizer pytree) instead of
+    # the equal split — lm_weights skews it ("3,1" = server 0 aims at
+    # 3/4 of the vector), empty = balanced cut on parameter boundaries.
+    lm=0,
+    lm_d_model=64,
+    lm_heads=4,
+    lm_layers=2,
+    lm_seq=128,
+    lm_steps=200,
+    lm_eval_every=50,
+    lm_use_flash=-1,  # -1 auto (flash on TPU) | 0 jnp reference | 1 flash
+    lm_weights="",
     # Device-resident data plane (mpit_tpu.dplane; docs/DEVICE.md):
     # servers hold shard + optimizer state as (mesh-sharded) HBM arrays
     # with donated jitted applies and publish an in-process device
@@ -300,6 +318,51 @@ def serve_cfg_for(cfg: Config):
     )
 
 
+def lm_trainer_cfg(cfg: Config) -> Config:
+    """The :data:`mpit_tpu.lm.trainer.LM_DEFAULTS`-shaped config for one
+    launch config: shared optimizer/loop knobs carried over verbatim,
+    lm_* knobs mapped onto the trainer's names."""
+    return Config(
+        d_model=int(cfg.get("lm_d_model", 64)),
+        n_heads=int(cfg.get("lm_heads", 4)),
+        n_layers=int(cfg.get("lm_layers", 2)),
+        seq_len=int(cfg.get("lm_seq", 128)),
+        steps=int(cfg.get("lm_steps", 200)),
+        eval_every=int(cfg.get("lm_eval_every", 50)),
+        use_flash=int(cfg.get("lm_use_flash", -1)),
+        opt=cfg.opt, lr=cfg.lr, lrd=cfg.lrd, lrp=cfg.lrp, mom=cfg.mom,
+        mommax=cfg.mommax, momdecay=cfg.momdecay, l2wd=cfg.l2wd,
+        mva=cfg.mva, su=cfg.su, batch=cfg.batch, seed=cfg.seed,
+        dtype=cfg.dtype, profile_dir=cfg.get("profile_dir", ""),
+    )
+
+
+def lm_layout(cfg: Config, n_servers: int):
+    """The gang's static weighted aligned-cut layout (one Shard per
+    server) under --lm: the deterministic cut every client and reader
+    must announce identically.  ``lm_weights`` ("3,1") skews the
+    targets; empty keeps balanced targets (still boundary-aligned, so
+    it differs from the raw equal split)."""
+    from mpit_tpu.lm import build, plan
+
+    tcfg = lm_trainer_cfg(cfg)
+    # Param *shapes* don't depend on the attention implementation, so
+    # layout derivation never touches the accelerator kernels.
+    model = build(d_model=tcfg.d_model, n_heads=tcfg.n_heads,
+                  n_layers=tcfg.n_layers, seq_len=tcfg.seq_len,
+                  seed=tcfg.seed, use_flash=False)
+    params = model.flat.unravel(model.flat.w0)
+    spec = str(cfg.get("lm_weights", "") or "")
+    weights = ([float(x) for x in spec.split(",") if x.strip() != ""]
+               if spec else None)
+    if weights is not None and len(weights) != n_servers:
+        raise ValueError(
+            f"--lm_weights names {len(weights)} servers but the role "
+            f"split made {n_servers}")
+    rule = cfg.opt if cfg.opt in rules_mod.names() else "add"
+    return plan(params, n_servers, rule=rule, server_weights=weights).layout
+
+
 def _serve_vec_len(cfg: Config, rank: int) -> int:
     """The flat parameter-vector length a reader must mirror — derived
     exactly the way the trainer derives it (same model ctor + flatten),
@@ -312,6 +375,14 @@ def _serve_vec_len(cfg: Config, rank: int) -> int:
     from mpit_tpu.train.trainer import MODELS
 
     full = TRAINER_DEFAULTS.merged(cfg.to_dict())
+    if int(cfg.get("lm", 0)):
+        from mpit_tpu.lm import build
+
+        tcfg = lm_trainer_cfg(cfg)
+        model = build(d_model=tcfg.d_model, n_heads=tcfg.n_heads,
+                      n_layers=tcfg.n_layers, seq_len=tcfg.seq_len,
+                      seed=tcfg.seed, use_flash=False)
+        return int(model.flat.size)
     x_train = load_mnist(side=full.side)[0][0]
     if full.model == "cnn":
         module = MnistCNN(num_classes=10, side=full.side)
@@ -409,6 +480,10 @@ def run_reader(rank: int, sranks: List[int], cfg: Config,
                else str(cfg.get("codec", "") or "") or None),
         ft=ft_from_cfg(cfg),
         cells=(cell_map_for(sranks, cell_ranks) if cell_ranks else None),
+        # --lm readers must announce the identical weighted cut the
+        # writers announced (servers reject a disagreeing attach).
+        layout=(lm_layout(cfg, len(sranks)) if int(cfg.get("lm", 0))
+                else None),
     )
     mirror = np.zeros(_serve_vec_len(cfg, rank),
                       np.dtype(str(cfg.get("dtype", "float32"))))
@@ -527,11 +602,25 @@ def run_rank(
                 "--resume restores parameter-server shards and needs "
                 "--np > 1 (single-process runs have no servers)"
             )
+        if int(cfg.get("lm", 0)):
+            from mpit_tpu.lm import LmTrainer
+
+            return {"role": "local",
+                    **LmTrainer(lm_trainer_cfg(cfg), rank=rank).run()}
         trainer = MnistTrainer(cfg, pclient=None, data=data, rank=rank)
         return {"role": "local", **trainer.run()}
 
     elastic_on = bool(cfg.get("elastic", False))
     sc_on = bool(cfg.get("shardctl", False)) or elastic_on
+    lm_on = int(cfg.get("lm", 0))
+    if lm_on:
+        if str(cfg.get("tester", "none")) != "none":
+            raise ValueError("--lm and a tester rank are mutually "
+                             "exclusive (the tester is MNIST-only)")
+        if int(cfg.get("cells", 0) or 0):
+            raise ValueError("--lm and --cells are not composed yet: the "
+                             "cell fabric derives the equal split, not "
+                             "the LM plan's weighted cut")
     # Under --elastic the transport spans the provisioned ceiling
     # (np0 + spares); roles split over the initial membership np0 and
     # ranks beyond it are joiner-server slots the controller may spawn.
@@ -746,6 +835,10 @@ def run_rank(
         sc_shards_per_server=(
             int(cfg.get("elastic_shards_per_server", 2) or 1)
             if elastic_on else 1),
+        # --lm: the weighted aligned-cut layout replaces the equal
+        # split on the static path (shardctl owns placement otherwise).
+        layout=(lm_layout(cfg, len(sranks)) if lm_on and not sc_on
+                else None),
     )
     if int(cfg.get("dplane", 0)):
         from mpit_tpu.dplane import ExchangeClient
@@ -773,7 +866,12 @@ def run_rank(
                       deadline_s=float(cfg.get("agg_deadline_s", 5.0)),
                       chunk_bytes=int(cfg.get("agg_chunk_bytes", 0))),
             namespace=str(cfg.get("namespace", "") or ""))
-    trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
+    if lm_on:
+        from mpit_tpu.lm import LmTrainer
+
+        trainer = LmTrainer(lm_trainer_cfg(cfg), pclient=pclient, rank=rank)
+    else:
+        trainer = MnistTrainer(cfg, pclient=pclient, data=data, rank=rank)
     log.info("worker with servers %s", sranks)
     return {"role": "worker", **trainer.run()}
 
@@ -879,7 +977,15 @@ def device_env_overrides(cfg: Config, size: int) -> Dict[int, Dict[str, str]]:
 def launch_processes(cfg: Config, timeout: float = 3600.0) -> Dict[int, Dict[str, Any]]:
     # Fail fast in the parent: a bad optimizer name discovered only inside a
     # worker child would strand the server children in their stop protocol.
-    if cfg.opt not in MnistTrainer.KNOWN_OPTS:
+    if int(cfg.get("lm", 0)):
+        from mpit_tpu.lm import LmTrainer
+
+        if cfg.opt not in LmTrainer.KNOWN_OPTS:
+            raise ValueError(
+                f"unknown LM optimizer {cfg.opt!r}; have "
+                f"{LmTrainer.KNOWN_OPTS}"
+            )
+    elif cfg.opt not in MnistTrainer.KNOWN_OPTS:
         raise ValueError(
             f"unknown optimizer {cfg.opt!r}; have {MnistTrainer.KNOWN_OPTS}"
         )
@@ -1014,7 +1120,8 @@ def main(argv: Optional[List[str]] = None) -> None:
 def _summarize(result: Dict[str, Any]) -> Dict[str, Any]:
     keep = {"role", "final_test_err", "time_to_target", "elapsed",
             "grads_applied", "params_served", "best_test_err",
-            "reads", "monotone", "busy_honored"}
+            "reads", "monotone", "busy_honored",
+            "final_loss", "final_eval_loss", "tokens_per_s", "tokens_total"}
     return {k: v for k, v in result.items() if k in keep}
 
 

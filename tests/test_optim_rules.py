@@ -160,6 +160,23 @@ class TestRegistry:
         with pytest.raises(ValueError):
             rules.make("nope")
 
+    def test_state_slots_pins_real_init_shapes(self):
+        # STATE_SLOTS is the footprint model's load-bearing constant
+        # (bytes per server = (1 + slots) * 4 * elems): pin it against
+        # what each rule's init ACTUALLY allocates per element.
+        p = jnp.zeros(7, jnp.float32)
+        for name in rules.names():
+            state = rules.make(name).init(p)
+            vector_arrays = sum(
+                1 for v in state.values() if np.ndim(v) == 1)
+            assert vector_arrays == rules.state_slots(name), name
+            # anything that is not per-element must be a free scalar
+            assert all(np.ndim(v) in (0, 1) for v in state.values()), name
+
+    def test_state_slots_unknown_raises(self):
+        with pytest.raises(ValueError):
+            rules.state_slots("nope")
+
 
 def quadratic_vgf(w, target):
     """loss = 0.5*||w-target||², grad = w-target."""

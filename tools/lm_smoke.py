@@ -1,0 +1,205 @@
+"""CI flagship-workload smoke (docs/WORKLOADS.md §6): a tiny LM gang —
+2 weighted-layout servers + 2 DOWNPOUR workers + 1 mid-run eval
+reader — trained through chunked int8 streaming with a drop/dup
+FaultPlan on the data channels.
+
+The two workers are driven round-robin from one ticketed loop (worker
+0's step k completes before worker 1's step k starts), so the servers'
+grad-application order is pinned and the faulty run is comparable
+bitwise to a fault-free control: retries and duplicate deliveries may
+reorder *attempts*, but dedup applies each op exactly once in ticket
+order.
+
+Asserts, loudly:
+- training trains: each worker's NLL descends from its first window;
+- the eval reader attaches MID-RUN with the same weighted layout,
+  reads without disturbing training, and its final read scores better
+  than the init params on the held-out stream;
+- final params BITWISE equal to the fault-free control gang;
+- faults actually bit (client retries > 0, server dup drops > 0);
+- the obs trace validates and the causal analyzer reports zero
+  violations.
+
+Usage: python tools/lm_smoke.py <trace_out.json>
+"""
+
+import json
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax.numpy as jnp  # noqa: E402
+
+from mpit_tpu import obs  # noqa: E402
+from mpit_tpu.comm.local import LocalRouter  # noqa: E402
+from mpit_tpu.ft import FaultPlan, FaultyTransport, FTConfig  # noqa: E402
+from mpit_tpu.lm import LmTrainer, build, plan  # noqa: E402
+from mpit_tpu.obs import causal as obs_causal  # noqa: E402
+from mpit_tpu.obs import trace as obs_trace  # noqa: E402
+from mpit_tpu.ps import ParamClient, ParamServer, tags  # noqa: E402
+from mpit_tpu.ps.serve import ReaderClient  # noqa: E402
+from mpit_tpu.utils.config import Config  # noqa: E402
+
+D_MODEL, N_LAYERS, SEQ, BATCH = 32, 1, 64, 4
+STEPS = 24
+READ_AT = STEPS // 2          # the reader attaches mid-run
+CHUNK_BYTES = 16384
+WEIGHTS = [2.0, 1.0]          # uneven cut: the layout is load-bearing
+DATA_TAGS = frozenset({tags.GRAD, tags.PARAM_REQ, tags.PARAM_PUSH})
+
+CFG = Config(d_model=D_MODEL, n_heads=2, n_layers=N_LAYERS, seq_len=SEQ,
+             batch=BATCH, opt="downpour", lr=0.3, su=1, steps=STEPS,
+             eval_every=0, seed=3, use_flash=0)
+
+
+def run_gang(faults=False):
+    """One ticketed training run; returns (final_params, per-worker
+    losses, reader eval losses, retries, dup_ops)."""
+    nservers, nworkers = 2, 2
+    n = nservers + nworkers + 1  # + the eval reader rank
+    router = LocalRouter(n)
+    sranks = list(range(nservers))
+    cranks = [nservers, nservers + 1]
+    reader_rank = nservers + nworkers
+    ft = FTConfig(op_deadline_s=2.0, max_retries=8,
+                  backoff_base_s=0.01, backoff_cap_s=0.05,
+                  chunk_bytes=CHUNK_BYTES)
+    model = build(d_model=D_MODEL, n_heads=2, n_layers=N_LAYERS,
+                  seq_len=SEQ, seed=CFG.seed, use_flash=False)
+    layout = plan(model.flat.unravel(model.flat.w0), nservers,
+                  server_weights=WEIGHTS).layout
+    servers, threads = [], []
+    for r in sranks:
+        servers.append(ParamServer(r, cranks, router.endpoint(r),
+                                   rule="add", ft=ft,
+                                   reader_ranks=[reader_rank]))
+        threads.append(threading.Thread(target=servers[-1].start,
+                                        daemon=True))
+    for t in threads:
+        t.start()
+
+    def wire(rank, seed):
+        ep = router.endpoint(rank)
+        if faults:
+            ep = FaultyTransport(ep, FaultPlan(seed=seed, drop_every=7,
+                                               dup_every=11,
+                                               tags=DATA_TAGS))
+        return ep
+
+    trainers, opts, ws, clients = [], [], [], []
+    for i, r in enumerate(cranks):
+        client = ParamClient(r, sranks, wire(r, 5 + i),
+                             seed_servers=(i == 0), codec="int8",
+                             ft=ft, layout=layout)
+        clients.append(client)
+        tr = LmTrainer(CFG, pclient=client, rank=r)
+        trainers.append(tr)
+        opts.append(tr.optimizer)
+        ws.append(tr.w)
+
+    # start() blocks on INIT+seed, which needs every client announced —
+    # run the two starts concurrently, then fall back to ticketed steps
+    def _start(i):
+        ws[i] = opts[i].start(ws[i])
+
+    starters = [threading.Thread(target=_start, args=(i,)) for i in (0, 1)]
+    for t in starters:
+        t.start()
+    for t in starters:
+        t.join(120)
+        assert not t.is_alive(), "client start hung"
+
+    losses = [[], []]
+    reader_losses = []
+    rc = None
+    mirror = np.zeros(model.flat.size, np.float32)
+    eval_tokens = jnp.asarray(trainers[0].eval_stream.batch_at(0))
+    init_eval = float(model.loss(jnp.asarray(model.flat.w0), eval_tokens))
+    for step in range(STEPS):
+        # ticketed turn-taking: one worker's sync step at a time, so
+        # server application order is identical with and without faults
+        for i, tr in enumerate(trainers):
+            tokens = jnp.asarray(tr.stream.batch_at(step))
+            ws[i], loss = opts[i].step(ws[i], tokens)
+            losses[i].append(float(loss))
+        if step == READ_AT - 1:
+            # mid-run attach: same weighted layout, read-only path
+            rc = ReaderClient(reader_rank, sranks,
+                              wire(reader_rank, 99), codec="int8",
+                              ft=ft, layout=layout)
+            rc.start(mirror)
+        if rc is not None and (step + 1) % 4 == 0:
+            rc.read_params()
+            reader_losses.append(
+                float(model.loss(jnp.asarray(mirror), eval_tokens)))
+    # "final params" = the servers' params, read through the serving
+    # tier after the last ticketed step (same decode both runs)
+    rc.read_params()
+    final = mirror.copy()
+    retries = sum(c.retries for c in clients) + rc.retries
+    dups = sum(s.dup_ops for s in servers)
+    rc.stop()
+    for opt in opts:
+        opt.stop()
+    for s in servers:
+        s.live.stop()
+    for t in threads:
+        t.join(120)
+        assert not t.is_alive(), "server never stopped"
+    return final, losses, reader_losses, init_eval, retries, dups
+
+
+def main(trace_path: str) -> int:
+    # Control first, obs off — its timings must not ride the trace.
+    control, c_losses, _r, _i, _re, _d = run_gang(faults=False)
+
+    obs.configure(enabled=True, reset=True)
+    final, losses, reader_losses, init_eval, retries, dups = run_gang(
+        faults=True)
+
+    for i, ls in enumerate(losses):
+        first = float(np.mean(ls[: len(ls) // 3]))
+        last = float(np.mean(ls[-len(ls) // 3:]))
+        assert last < first, (
+            f"worker {i} never learned: first window {first:.4f} -> "
+            f"last {last:.4f}")
+    assert reader_losses, "the eval reader never completed a read"
+    assert reader_losses[-1] < init_eval, (
+        f"mid-run reads never beat the init params on held-out data: "
+        f"{reader_losses[-1]:.4f} vs {init_eval:.4f}")
+    assert np.array_equal(control, final), (
+        "faulty run diverged bitwise from the fault-free control — "
+        "drop/dup recovery broke the ticketed determinism contract")
+    assert retries > 0, "the drop plan never forced a retry"
+    assert dups > 0, "no duplicate delivery was ever deduped"
+
+    obs_trace.write_rank_trace(trace_path, 0, role="lm_smoke")
+    report = obs_trace.validate_trace(trace_path)
+    analysis = obs_causal.analyze(trace_path)
+    assert not analysis["violations"], (
+        f"causal analyzer violations: {analysis['violations'][:3]}")
+    print("lm-smoke OK: "
+          f"loss {[round(ls[0], 3) for ls in losses]} -> "
+          f"{[round(ls[-1], 3) for ls in losses]}, reader "
+          f"{round(init_eval, 3)} -> {round(reader_losses[-1], 3)} "
+          f"({len(reader_losses)} mid-run reads), retries={retries}, "
+          f"dups={dups}, trace events={report.get('events')}")
+    print(json.dumps({
+        "loss_first": [ls[0] for ls in losses],
+        "loss_last": [ls[-1] for ls in losses],
+        "reader_losses": reader_losses,
+        "init_eval": init_eval,
+        "bitwise": True,
+        "retries": retries,
+        "dups": dups,
+        "trace_events": report.get("events"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
+                  "/tmp/mpit_lm_smoke_trace.json"))
